@@ -1,0 +1,23 @@
+"""llm-weighted-consensus-tpu: TPU-native weighted consensus over LLM panels.
+
+A brand-new framework with the capabilities of ObjectiveAI/llm-weighted-consensus
+(the Rust reference surveyed in SURVEY.md), rebuilt TPU-first:
+
+* ``types``    — pure wire-type core + streaming merge algebra
+* ``identity`` — judge/panel canonicalization, validation, content-addressed ids
+* ``ballot``   — randomized prefix-tree ballots + vote extraction
+* ``clients``  — asyncio SSE chat client, consensus engine, multichat fan-out
+* ``archive``  — completions archive (checkpoint/resume analog) + batch re-score
+* ``weights``  — static / training-table weight resolution (TPU embedding path)
+* ``models``   — on-TPU encoders (BGE-class BERT, DeBERTa reward model)
+* ``ops``      — JAX/Pallas consensus kernels (cosine vote, tally, top-k)
+* ``parallel`` — device mesh, shardings, collectives, batch pmap
+* ``serve``    — SSE HTTP gateway + env config
+* ``train``    — trained-weight / encoder training steps
+
+Pure-core modules import no IO or JAX; device modules import JAX lazily.
+"""
+
+__version__ = "0.1.0"
+
+from . import errors, types, utils  # noqa: F401

@@ -1,0 +1,234 @@
+"""Uniform error taxonomy — ``{code, message}`` JSON errors.
+
+Parity target: reference src/error.rs (StatusError trait + ResponseError),
+src/chat/completions/error.rs (chat client errors incl. OpenRouter provider
+error passthrough) and src/score/completions/error.rs (consensus errors).
+Every error renders as ``{"code": <http status>, "message": <json>}`` and the
+message payloads carry the same ``kind`` discriminators as the reference so
+clients can switch on them.
+"""
+
+from __future__ import annotations
+
+from http import HTTPStatus
+from typing import Optional
+
+from .types.base import ResponseError  # noqa: F401  (canonical home: type core)
+
+
+def _status_phrase(code: int) -> str:
+    try:
+        return f"{code} {HTTPStatus(code).phrase}"
+    except ValueError:
+        return "unknown"
+
+
+class StatusError(Exception):
+    """Base for rich errors that know their HTTP status + JSON message."""
+
+    def status(self) -> int:
+        return 500
+
+    def message(self):
+        return None
+
+    def to_response_error(self) -> ResponseError:
+        msg = self.message()
+        if msg is None:
+            msg = _status_phrase(self.status())
+        return ResponseError(code=self.status(), message=msg)
+
+
+def to_response_error(err) -> ResponseError:
+    if isinstance(err, ResponseError):
+        return err
+    if isinstance(err, StatusError):
+        return err.to_response_error()
+    return ResponseError(code=500, message=str(err))
+
+
+# ---------------------------------------------------------------------------
+# Chat client errors (reference src/chat/completions/error.rs)
+# ---------------------------------------------------------------------------
+
+
+class ChatError(StatusError):
+    kind = "chat"
+
+    def __init__(self, inner_kind: str, error, code: int = 500):
+        super().__init__(f"{self.kind}/{inner_kind}: {error}")
+        self.inner_kind = inner_kind
+        self.error = error
+        self.code = code
+
+    def status(self) -> int:
+        return self.code
+
+    def message(self):
+        return {"kind": "chat", "error": {"kind": self.inner_kind, "error": self.error}}
+
+
+class TransportError(ChatError):
+    def __init__(self, error: str, code: int = 500):
+        super().__init__("transport", error, code)
+
+
+class EmptyStreamError(ChatError):
+    def __init__(self):
+        super().__init__("empty_stream", "received an empty stream", 500)
+
+
+class DeserializationError(ChatError):
+    def __init__(self, error: str):
+        super().__init__("deserialization", error, 500)
+
+
+class BadStatusError(ChatError):
+    def __init__(self, code: int, body):
+        super().__init__("bad_status", body, code)
+
+
+class StreamTimeoutError(ChatError):
+    def __init__(self):
+        super().__init__("stream_timeout", "error fetching stream: timeout", 500)
+
+
+class CtxHandlerError(ChatError):
+    def __init__(self, inner: ResponseError):
+        super().__init__("ctx", inner.message, inner.code)
+
+    def message(self):
+        return self.error
+
+
+class ArchiveFetchError(ChatError):
+    def __init__(self, inner: ResponseError):
+        super().__init__("completions_archive", inner.message, inner.code)
+
+    def message(self):
+        return self.error
+
+
+class InvalidCompletionChoiceIndex(ChatError):
+    def __init__(self, completion_id: str, choice_index: int):
+        super().__init__(
+            "invalid_completion_choice_index",
+            f"invalid choice_index for completion {completion_id}: {choice_index}",
+            400,
+        )
+        self.completion_id = completion_id
+        self.choice_index = choice_index
+
+
+class ProviderError(ChatError):
+    """OpenRouter mid-stream provider error shape (error.rs:99-141)."""
+
+    def __init__(self, code: Optional[int], message, metadata=None, user_id=None):
+        self.provider_code = code
+        self.provider_message = message
+        self.metadata = metadata
+        self.user_id = user_id
+        super().__init__("provider", message, code if code is not None else 500)
+
+    def message(self):
+        return {
+            "kind": "provider",
+            "message": self.provider_message,
+            "metadata": self.metadata,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Score / consensus errors (reference src/score/completions/error.rs)
+# ---------------------------------------------------------------------------
+
+
+class ScoreError(StatusError):
+    def __init__(self, inner_kind: str, error, code: int = 500):
+        super().__init__(f"score/{inner_kind}: {error}")
+        self.inner_kind = inner_kind
+        self.error = error
+        self.code = code
+
+    def status(self) -> int:
+        return self.code
+
+    def message(self):
+        return {
+            "kind": "score",
+            "error": {"kind": self.inner_kind, "error": self.error},
+        }
+
+
+class FetchModelError(ScoreError):
+    def __init__(self, inner: ResponseError):
+        super().__init__("fetch_model", inner.message, inner.code)
+
+    def message(self):
+        return {"kind": "score", "error": self.error}
+
+
+class FetchModelWeightsError(ScoreError):
+    def __init__(self, inner: ResponseError):
+        super().__init__("fetch_model_weights", inner.message, inner.code)
+
+    def message(self):
+        return {"kind": "score", "error": self.error}
+
+
+class InvalidModelError(ScoreError):
+    def __init__(self, error: str):
+        super().__init__("invalid_model", error, 400)
+
+
+class ExpectedTwoOrMoreChoices(ScoreError):
+    def __init__(self, got: int):
+        super().__init__(
+            "expected_two_or_more_choices",
+            f"expected 2 or more provided choices but got {got}",
+            400,
+        )
+
+
+class InvalidContentError(ScoreError):
+    """No parseable ballot key in a judge's output."""
+
+    def __init__(self):
+        super().__init__("invalid_content", "expected a valid response key", 500)
+
+
+class AllVotesFailed(ScoreError):
+    def __init__(self, code: Optional[int]):
+        super().__init__(
+            "all_votes_failed",
+            "all votes failed, see choices for further details",
+            code if code is not None else 500,
+        )
+
+
+class ScoreArchiveError(ScoreError):
+    def __init__(self, inner: ResponseError):
+        super().__init__("completions_archive", inner.message, inner.code)
+
+    def message(self):
+        return {"kind": "score", "error": self.error}
+
+
+class ScoreInvalidCompletionChoiceIndex(ScoreError):
+    def __init__(self, completion_id: str, choice_index: int):
+        super().__init__(
+            "invalid_completion_choice_index",
+            f"invalid choice_index for completion {completion_id}: {choice_index}",
+            400,
+        )
+
+
+class ScoreChatError(ScoreError):
+    """Chat error surfaced through the score endpoint (transparent wrap)."""
+
+    def __init__(self, chat_error: ChatError):
+        self.chat_error = chat_error
+        super().__init__("chat", str(chat_error), chat_error.status())
+
+    def message(self):
+        return {"kind": "score", "error": self.chat_error.message()}

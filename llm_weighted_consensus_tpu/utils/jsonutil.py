@@ -1,0 +1,168 @@
+"""Canonical JSON serialization with exact ``Decimal`` support.
+
+The reference crate serializes every wire type with serde_json and hashes the
+resulting canonical string to derive content-addressed model identities
+(reference: src/score/llm/mod.rs:513-518).  Python's stdlib ``json`` cannot emit
+``decimal.Decimal`` values as bare JSON numbers without precision loss, so this
+module implements a small, fully deterministic JSON writer:
+
+* declared field order is preserved (dicts keep insertion order),
+* ``Decimal`` values are emitted verbatim (``1.0`` stays ``1.0``, not ``1``),
+* floats are emitted with ``repr`` (shortest round-trip form),
+* no whitespace (serde_json compact form) unless ``pretty=True`` (serde_json
+  ``to_string_pretty`` form: 2-space indent), which the ballot serializer needs
+  (reference: src/score/completions/client.rs:1580-1603).
+
+The identity scheme built on top of this writer is *structurally* equivalent to
+the reference's (same canonicalized fields, same xxh3-128 + base62 pipeline) but
+not byte-compatible with rust_decimal/serde formatting; ids are therefore
+versioned as this framework's own id space (see identity/__init__.py).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from decimal import Decimal
+
+_ESCAPE_MAP = {
+    "\\": "\\\\",
+    '"': '\\"',
+    "\b": "\\b",
+    "\f": "\\f",
+    "\n": "\\n",
+    "\r": "\\r",
+    "\t": "\\t",
+}
+
+
+def _escape_string(s: str) -> str:
+    out = []
+    for ch in s:
+        esc = _ESCAPE_MAP.get(ch)
+        if esc is not None:
+            out.append(esc)
+        elif ch < "\x20":
+            out.append("\\u%04x" % ord(ch))
+        else:
+            out.append(ch)
+    return '"' + "".join(out) + '"'
+
+
+def _format_decimal(d: Decimal) -> str:
+    # Emit the decimal exactly as constructed, in plain (non-scientific)
+    # notation, matching rust_decimal's display form.
+    if not d.is_finite():
+        raise ValueError("cannot serialize non-finite Decimal to JSON")
+    return format(d, "f")
+
+
+def _format_float(f: float) -> str:
+    if math.isnan(f) or math.isinf(f):
+        raise ValueError("cannot serialize non-finite float to JSON")
+    # repr is the shortest round-trip form; whole numbers print as `1.0`,
+    # matching serde_json's f64 output.
+    return repr(f)
+
+
+def dumps(obj, *, pretty: bool = False) -> str:
+    """Serialize ``obj`` (dict/list/str/bool/None/int/float/Decimal) to JSON."""
+    out: list[str] = []
+    if pretty:
+        _write_pretty(obj, out, 0)
+    else:
+        _write_compact(obj, out)
+    return "".join(out)
+
+
+def _write_scalar(obj, out: list[str]) -> bool:
+    if obj is None:
+        out.append("null")
+    elif obj is True:
+        out.append("true")
+    elif obj is False:
+        out.append("false")
+    elif isinstance(obj, str):
+        out.append(_escape_string(obj))
+    elif isinstance(obj, Decimal):
+        out.append(_format_decimal(obj))
+    elif isinstance(obj, int):
+        out.append(str(obj))
+    elif isinstance(obj, float):
+        out.append(_format_float(obj))
+    else:
+        return False
+    return True
+
+
+def _write_compact(obj, out: list[str]) -> None:
+    if _write_scalar(obj, out):
+        return
+    if isinstance(obj, dict):
+        out.append("{")
+        first = True
+        for k, v in obj.items():
+            if not first:
+                out.append(",")
+            first = False
+            out.append(_escape_string(str(k)))
+            out.append(":")
+            _write_compact(v, out)
+        out.append("}")
+    elif isinstance(obj, (list, tuple)):
+        out.append("[")
+        first = True
+        for v in obj:
+            if not first:
+                out.append(",")
+            first = False
+            _write_compact(v, out)
+        out.append("]")
+    else:
+        raise TypeError(f"cannot serialize {type(obj)!r} to JSON")
+
+
+def _write_pretty(obj, out: list[str], indent: int) -> None:
+    if _write_scalar(obj, out):
+        return
+    pad = "  " * (indent + 1)
+    end_pad = "  " * indent
+    if isinstance(obj, dict):
+        if not obj:
+            out.append("{}")
+            return
+        out.append("{\n")
+        first = True
+        for k, v in obj.items():
+            if not first:
+                out.append(",\n")
+            first = False
+            out.append(pad)
+            out.append(_escape_string(str(k)))
+            out.append(": ")
+            _write_pretty(v, out, indent + 1)
+        out.append("\n")
+        out.append(end_pad)
+        out.append("}")
+    elif isinstance(obj, (list, tuple)):
+        if not obj:
+            out.append("[]")
+            return
+        out.append("[\n")
+        first = True
+        for v in obj:
+            if not first:
+                out.append(",\n")
+            first = False
+            out.append(pad)
+            _write_pretty(v, out, indent + 1)
+        out.append("\n")
+        out.append(end_pad)
+        out.append("]")
+    else:
+        raise TypeError(f"cannot serialize {type(obj)!r} to JSON")
+
+
+def loads(s: str):
+    """Parse JSON preserving exact decimal literals as ``Decimal``."""
+    return json.loads(s, parse_float=Decimal)

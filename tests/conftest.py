@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Device tests run on a simulated 8-device CPU mesh (SURVEY §4: the TPU analog
+of "multi-node without a real cluster").  The env vars must be set before JAX
+initializes its backends, hence here, before any test module imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,225 @@
+"""Identity layer tests: canonicalization, validation, hash stability.
+
+Reference behaviors under test: src/score/llm/mod.rs:76-588 (prepare/validate/
+ids) and src/score/model/mod.rs:37-199 (panel assembly).  Includes golden id
+strings guarding hash stability of this framework's id space across refactors.
+"""
+
+from decimal import Decimal
+
+import pytest
+
+from llm_weighted_consensus_tpu.identity import (
+    LlmBase,
+    ModelBase,
+    WeightStatic,
+    base62_encode,
+    id_string,
+)
+
+
+def llm(s: str) -> LlmBase:
+    return LlmBase.from_json(s)
+
+
+def test_base62_known_values():
+    assert base62_encode(0) == "0"
+    assert base62_encode(61) == "z"
+    assert base62_encode(62) == "10"
+    assert len(id_string((1 << 128) - 1)) == 22
+
+
+def test_prepare_drops_defaults():
+    a = llm('{"model":"m","temperature":1.0,"top_p":1.0,"frequency_penalty":0.0,'
+            '"min_p":0.0,"repetition_penalty":1.0,"top_a":0.0,"top_k":0,'
+            '"verbosity":"medium","models":[],"logit_bias":{},"top_logprobs":0,'
+            '"synthetic_reasoning":false}')
+    b = llm('{"model":"m"}')
+    a.prepare()
+    b.prepare()
+    assert a.to_json() == b.to_json()
+    assert a.id_string() == b.id_string()
+
+
+def test_prepare_stop_canonicalization():
+    one = llm('{"model":"m","stop":["x"]}')
+    one.prepare()
+    assert one.stop == "x"
+    many = llm('{"model":"m","stop":["b","a"]}')
+    many.prepare()
+    assert many.stop == ["a", "b"]
+    empty = llm('{"model":"m","stop":[]}')
+    empty.prepare()
+    assert empty.stop is None
+
+
+def test_prepare_provider_canonicalization():
+    a = llm('{"model":"m","provider":{"allow_fallbacks":true,"only":["z","a"],'
+            '"data_collection":"allow","require_parameters":false}}')
+    a.prepare()
+    assert a.provider.allow_fallbacks is None
+    assert a.provider.only == ["a", "z"]
+    assert a.provider.data_collection is None
+    b = llm('{"model":"m","provider":{"allow_fallbacks":true}}')
+    b.prepare()
+    assert b.provider is None
+
+
+def test_prepare_reasoning():
+    a = llm('{"model":"m","reasoning":{"enabled":true,"effort":"high"}}')
+    a.prepare()
+    assert a.reasoning.enabled is None and a.reasoning.effort == "high"
+    b = llm('{"model":"m","reasoning":{"enabled":false}}')
+    b.prepare()
+    assert b.reasoning is None
+    c = llm('{"model":"m","reasoning":{"max_tokens":0}}')
+    c.prepare()
+    assert c.reasoning is None
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        '{"model":""}',
+        '{"model":"m","temperature":2.5}',
+        '{"model":"m","top_p":1.5}',
+        '{"model":"m","frequency_penalty":-3.0}',
+        '{"model":"m","top_logprobs":21}',
+        '{"model":"m","logit_bias":{"abc":1}}',
+        '{"model":"m","logit_bias":{"007":1}}',
+        '{"model":"m","logit_bias":{"5":101}}',
+        '{"model":"m","stop":""}',
+        '{"model":"m","stop":["a","a"]}',
+        '{"model":"m","models":["m"]}',
+        '{"model":"m","models":["x","x"]}',
+        '{"model":"m","weight":{"type":"static","weight":0}}',
+        '{"model":"m","weight":{"type":"static","weight":-1}}',
+        '{"model":"m","output_mode":"instruction","synthetic_reasoning":true}',
+        '{"model":"m","reasoning":{"effort":"high","max_tokens":5}}',
+        '{"model":"m","reasoning":{"enabled":false,"effort":"high"}}',
+    ],
+)
+def test_validate_rejects(body):
+    with pytest.raises(ValueError):
+        llm(body).into_llm_without_indices()
+
+
+def test_three_identities():
+    j = llm('{"model":"m","weight":{"type":"training_table","base_weight":1.0,'
+            '"min_weight":0.5,"max_weight":2.0},"output_mode":"json_schema",'
+            '"top_logprobs":5}')
+    v = j.into_llm_without_indices()
+    assert len(v.id) == 22 and len(v.multichat_id) == 22
+    assert v.training_table_id is not None and len(v.training_table_id) == 22
+    # training_table id ignores weight bounds
+    j2 = llm('{"model":"m","weight":{"type":"training_table","base_weight":1.0,'
+             '"min_weight":0.1,"max_weight":9.0},"output_mode":"json_schema",'
+             '"top_logprobs":5}')
+    v2 = j2.into_llm_without_indices()
+    assert v2.id != v.id
+    assert v2.training_table_id == v.training_table_id
+    # multichat id additionally ignores output_mode/top_logprobs
+    j3 = llm('{"model":"m","weight":{"type":"training_table","base_weight":1.0,'
+             '"min_weight":0.1,"max_weight":9.0}}')
+    v3 = j3.into_llm_without_indices()
+    assert v3.multichat_id == v.multichat_id
+    # static judges have no training table id
+    s = llm('{"model":"m"}').into_llm_without_indices()
+    assert s.training_table_id is None
+
+
+GOLDEN_IDS = {
+    # Hash-stability goldens for this framework's id space ("v1").  If these
+    # change, archived model references break — never change them silently.
+    '{"model":"openai/gpt-4o"}': "4qbuZ37QDDwn4bFtDBzbK1",
+    '{"model":"openai/gpt-4o","weight":{"type":"static","weight":2.5}}':
+        "4GN7JNEPA7UT9cm71UiNYv",
+}
+
+
+def test_golden_ids():
+    for body, expected in GOLDEN_IDS.items():
+        base = llm(body)
+        base.prepare()
+        got = base.id_string()
+        assert got == expected, f"id drift for {body}: {got} != {expected}"
+
+
+def test_golden_ids_current():
+    # regenerate helper: prints current ids when goldens are first created
+    base = llm('{"model":"openai/gpt-4o"}')
+    base.prepare()
+    assert len(base.id_string()) == 22
+
+
+def test_model_assembly_sorted_and_indexed():
+    m = ModelBase.from_json(
+        '{"llms":[{"model":"zeta"},{"model":"alpha"},{"model":"alpha","weight":'
+        '{"type":"static","weight":3.0}}]}'
+    ).into_model_validate()
+    assert [l.index for l in m.llms] == [0, 1, 2]
+    assert m.llms[0].id == sorted(l.id for l in m.llms)[0]
+    assert len(m.id) == 22 and len(m.multichat_id) == 22
+    # same members, different declaration order -> same panel id
+    m2 = ModelBase.from_json(
+        '{"llms":[{"model":"alpha","weight":{"type":"static","weight":3.0}},'
+        '{"model":"zeta"},{"model":"alpha"}]}'
+    ).into_model_validate()
+    assert m2.id == m.id
+    assert m2.multichat_id == m.multichat_id
+
+
+def test_model_multichat_duplicate_indices():
+    # "alpha" twice with different weights = same generator, distinct slots
+    m = ModelBase.from_json(
+        '{"llms":[{"model":"alpha"},{"model":"alpha","weight":'
+        '{"type":"static","weight":3.0}},{"model":"beta"}]}'
+    ).into_model_validate()
+    mc = sorted((l.multichat_id, l.multichat_index) for l in m.llms)
+    ids = [x[1] for x in mc]
+    assert len(set(ids)) == 3, "duplicate generators get distinct consecutive slots"
+
+
+def test_model_limits():
+    with pytest.raises(ValueError):
+        ModelBase(llms=[]).into_model_validate()
+    from llm_weighted_consensus_tpu.identity.llm import LlmBase as LB
+
+    with pytest.raises(ValueError):
+        ModelBase(llms=[LB(model=f"m{i}") for i in range(129)]).into_model_validate()
+
+
+def test_panel_weight_mode_mismatch():
+    with pytest.raises(ValueError):
+        ModelBase.from_json(
+            '{"llms":[{"model":"m","weight":{"type":"training_table",'
+            '"base_weight":1.0,"min_weight":0.5,"max_weight":2.0}}]}'
+        ).into_model_validate()
+
+
+def test_training_table_panel():
+    m = ModelBase.from_json(
+        '{"weight":{"type":"training_table","embeddings":{"model":"bge-small-en",'
+        '"max_tokens":512},"top":10},'
+        '"llms":[{"model":"a","weight":{"type":"training_table","base_weight":1.0,'
+        '"min_weight":0.5,"max_weight":2.0}},'
+        '{"model":"b","weight":{"type":"training_table","base_weight":1.0,'
+        '"min_weight":0.5,"max_weight":2.0}}]}'
+    ).into_model_validate()
+    assert m.training_table_id is not None
+    assert [l.training_table_index for l in m.llms] == [0, 1]
+
+
+def test_static_weights():
+    m = ModelBase.from_json(
+        '{"llms":[{"model":"a","weight":{"type":"static","weight":2.0}},'
+        '{"model":"b"}]}'
+    ).into_model_validate()
+    ws = m.static_weights()
+    assert sorted(ws) == [Decimal("1.0"), Decimal("2.0")]
+
+
+def test_weight_static_default_frozen():
+    # the default weight shape participates in hashing and must never change
+    w = WeightStatic()
+    assert w.to_json() == '{"type":"static","weight":1.0}'

@@ -1,0 +1,224 @@
+"""Property tests for the streaming merge algebra (SURVEY §2.3, §4).
+
+Contracts under test (reference src/chat/completions/response.rs):
+* unary == fold(push, chunks) regardless of how the stream is split,
+* strings concatenate, usage adds, options first-write-win,
+* keyed lists (choices / tool calls) merge by index,
+* logprobs extend.
+"""
+
+import random
+from decimal import Decimal
+
+from llm_weighted_consensus_tpu.types import chat_response as cr
+from llm_weighted_consensus_tpu.types import multichat_response as mr
+from llm_weighted_consensus_tpu.types import score_response as sr
+from llm_weighted_consensus_tpu.types.base import fold_chunks
+
+
+def _chunk(content=None, *, index=0, finish=None, usage=None, reasoning=None,
+           tool_args=None, provider=None, fingerprint=None):
+    delta = cr.Delta(content=content, reasoning=reasoning)
+    if tool_args is not None:
+        delta.tool_calls = [
+            cr.StreamingToolCall(
+                index=0,
+                id="t0" if tool_args == "{" else None,
+                function=cr.StreamingToolCallFunction(name=None, arguments=tool_args),
+            )
+        ]
+    return cr.ChatCompletionChunk(
+        id="cmpl-1",
+        choices=[cr.StreamingChoice(delta=delta, finish_reason=finish, index=index)],
+        created=123,
+        model="m",
+        usage=usage,
+        provider=provider,
+        system_fingerprint=fingerprint,
+    )
+
+
+def make_stream():
+    return [
+        _chunk("Hel", provider="p1", fingerprint="fp"),
+        _chunk("lo ", reasoning="thinking..."),
+        _chunk("wor", index=1),
+        _chunk("ld", index=1, finish="length"),
+        _chunk(None, tool_args="{"),
+        _chunk(None, tool_args='"a":1}'),
+        _chunk(
+            "!",
+            finish="stop",
+            usage=cr.Usage(
+                completion_tokens=5,
+                prompt_tokens=7,
+                total_tokens=12,
+                cost=Decimal("0.5"),
+            ),
+        ),
+        _chunk(
+            None,
+            usage=cr.Usage(
+                completion_tokens=1,
+                prompt_tokens=0,
+                total_tokens=1,
+                cost=Decimal("0.25"),
+            ),
+        ),
+    ]
+
+
+def test_fold_matches_expected_unary():
+    agg = fold_chunks(make_stream())
+    unary = cr.ChatCompletion.from_streaming(agg)
+    by_index = {c.index: c for c in unary.choices}
+    assert by_index[0].message.content == "Hello !"
+    assert by_index[0].message.reasoning == "thinking..."
+    assert by_index[0].finish_reason == "stop"
+    assert by_index[1].message.content == "world"
+    assert by_index[1].finish_reason == "length"
+    tc = by_index[0].message.tool_calls[0]
+    assert tc.id == "t0"
+    assert tc.function.arguments == '{"a":1}'
+    assert unary.usage.completion_tokens == 6
+    assert unary.usage.cost == Decimal("0.75")
+    assert unary.provider == "p1"
+    assert unary.system_fingerprint == "fp"
+
+
+def test_fold_invariant_under_splits():
+    """Any way of pre-merging consecutive chunks yields the same aggregate."""
+    chunks = make_stream()
+    expected = fold_chunks(chunks).to_json()
+    rng = random.Random(42)
+    for _ in range(25):
+        # random split points -> pre-fold each segment, then fold the folds
+        points = sorted(rng.sample(range(1, len(chunks)), rng.randint(1, 4)))
+        segments = []
+        prev = 0
+        for p in points + [len(chunks)]:
+            segments.append(chunks[prev:p])
+            prev = p
+        refolded = fold_chunks(fold_chunks(seg) for seg in segments)
+        assert refolded.to_json() == expected
+
+
+def test_first_write_wins_options():
+    a = _chunk("x", provider="first")
+    b = _chunk("y", provider="second")
+    agg = fold_chunks([a, b])
+    assert agg.provider == "first"
+
+
+def test_logprobs_extend():
+    lp1 = cr.Logprobs(content=[cr.Logprob(token="a", logprob=Decimal("-0.1"))])
+    lp2 = cr.Logprobs(content=[cr.Logprob(token="b", logprob=Decimal("-0.2"))])
+    c1 = _chunk("a")
+    c1.choices[0].logprobs = lp1
+    c2 = _chunk("b")
+    c2.choices[0].logprobs = lp2
+    agg = fold_chunks([c1, c2])
+    tokens = [l.token for l in agg.choices[0].logprobs.content]
+    assert tokens == ["a", "b"]
+
+
+def test_tool_as_content():
+    delta = cr.Delta(
+        content="pre",
+        tool_calls=[
+            cr.StreamingToolCall(
+                index=0,
+                function=cr.StreamingToolCallFunction(name="f", arguments="ARGS"),
+            )
+        ],
+    )
+    delta.tool_as_content()
+    assert delta.content == "preARGS"
+    assert delta.tool_calls is None
+
+
+def test_score_chunk_merge_and_unary():
+    c1 = sr.ChatCompletionChunk(
+        id="scrcpl-1",
+        choices=[
+            sr.StreamingChoice(
+                delta=sr.Delta(content="ans", role="assistant"),
+                index=2,
+                weight=Decimal("2.0"),
+                model="judge-id",
+                model_index=0,
+            )
+        ],
+        created=1,
+        model="panel",
+    )
+    c2 = sr.ChatCompletionChunk(
+        id="scrcpl-1",
+        choices=[
+            sr.StreamingChoice(
+                delta=sr.Delta(vote=[Decimal("0.25"), Decimal("0.75")]),
+                finish_reason="stop",
+                index=2,
+            )
+        ],
+        created=1,
+        model="panel",
+    )
+    agg = fold_chunks([c1, c2])
+    unary = sr.ChatCompletion.from_streaming(agg)
+    choice = unary.choices[0]
+    assert choice.message.content == "ans"
+    assert choice.message.vote == [Decimal("0.25"), Decimal("0.75")]
+    assert choice.weight == Decimal("2.0")
+    assert choice.model == "judge-id"
+    assert choice.finish_reason == "stop"
+
+
+def test_score_roundtrip_includes_vote_and_weight_data():
+    chunk = sr.ChatCompletionChunk(
+        id="scrcpl-2",
+        choices=[],
+        created=5,
+        model="panel",
+        weight_data=sr.StaticData(),
+    )
+    s = chunk.to_json()
+    assert '"weight_data":{"type":"static"}' in s
+    back = sr.ChatCompletionChunk.from_json(s)
+    assert isinstance(back.weight_data, sr.StaticData)
+
+
+def test_multichat_merge():
+    c1 = mr.ChatCompletionChunk(
+        id="mchat-1",
+        choices=[
+            mr.StreamingChoice(
+                delta=cr.Delta(content="A"), index=0, model="m0", model_index=0
+            )
+        ],
+        created=1,
+        model="panel",
+    )
+    c2 = mr.ChatCompletionChunk(
+        id="mchat-1",
+        choices=[
+            mr.StreamingChoice(delta=cr.Delta(content="B"), index=0, finish_reason="stop")
+        ],
+        created=1,
+        model="panel",
+    )
+    unary = mr.ChatCompletion.from_streaming(fold_chunks([c1, c2]))
+    assert unary.choices[0].message.content == "AB"
+    assert unary.choices[0].model == "m0"
+
+
+def test_usage_with_total_cost():
+    u = cr.Usage(
+        cost=Decimal("0.5"),
+        cost_details=cr.CostDetails(upstream_inference_cost=Decimal("0.125")),
+    )
+    u.with_total_cost()
+    assert u.total_cost == Decimal("0.625")
+    # idempotent
+    u.with_total_cost()
+    assert u.total_cost == Decimal("0.625")

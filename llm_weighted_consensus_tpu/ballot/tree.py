@@ -1,0 +1,175 @@
+"""Prefix-tree ballot construction.
+
+Reference: src/score/completions/client.rs:1342-1631 (SelectPfx, SelectPfxTree,
+pfx_indices, json_serialize_select_choices, regex_patterns).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional, Union
+
+from ..utils import jsonutil
+
+# 20-symbol ballot alphabet (client.rs:1342-1364).  20 = the max
+# ``top_logprobs`` fan-out, so a single completion token can carry a full
+# probability distribution over one tree level.
+ALPHABET = "ABCDEFGHIJKLMNOPQRST"
+MAX_BRANCH = len(ALPHABET)
+
+# node representation: a Leaf is an int candidate index; a Branch is an
+# insertion-ordered dict letter -> node.
+Node = Union[int, dict]
+
+
+def branch_limit(top_logprobs: Optional[int]) -> int:
+    """Branching limit for a judge (client.rs:503-508).
+
+    ``top_logprobs`` >= 2 caps the branch factor so every sibling key letter
+    can appear among the logprob alternatives of one token; otherwise the
+    full alphabet is available.
+    """
+    if top_logprobs is None or top_logprobs in (0, 1):
+        return MAX_BRANCH
+    return min(top_logprobs, MAX_BRANCH)
+
+
+class PrefixTree:
+    """Randomized candidate->key assignment tree.
+
+    All leaves sit at the same depth (the splitter forces uniform sub-branch
+    nesting, client.rs:1494-1497), so key length == depth for every
+    candidate.
+    """
+
+    def __init__(self, root: Node, depth: int):
+        self.root = root
+        self.depth = depth
+
+    # -- construction (client.rs:1455-1516) ---------------------------------
+    #
+    # Deviation from the reference: its splitter propagates the
+    # force-sub-branch flag only one level, which produces *mixed* leaf
+    # depths for some (N, limit) combinations (e.g. N=9, limit=2) and then
+    # panics (`unreachable!()`) during vote extraction on the shallower
+    # keys.  We instead compute the uniform target depth up front — the
+    # smallest d with limit**d >= N — and split every node to exactly that
+    # depth, so key length is constant by construction.
+
+    @classmethod
+    def build(
+        cls, rng: random.Random, source_len: int, max_branch_len: int
+    ) -> "PrefixTree":
+        if source_len < 1:
+            raise ValueError("ballot needs at least one candidate")
+        if max_branch_len < 2:
+            raise ValueError("branch limit must be >= 2")
+        source = list(range(source_len))
+        rng.shuffle(source)
+        depth = 1
+        while max_branch_len**depth < source_len:
+            depth += 1
+        root = cls._build_node(rng, source, max_branch_len, depth)
+        return cls(root, depth)
+
+    @classmethod
+    def _build_node(
+        cls,
+        rng: random.Random,
+        source: list,
+        max_branch_len: int,
+        depth_remaining: int,
+    ) -> Node:
+        letters = list(ALPHABET)
+        rng.shuffle(letters)
+        if depth_remaining == 1:
+            return {letters[i]: idx for i, idx in enumerate(source)}
+        # minimal branching that keeps every child within the capacity of
+        # the remaining levels, sizes as even as possible
+        capacity = max_branch_len ** (depth_remaining - 1)
+        n = min(-(-len(source) // capacity), max_branch_len)
+        base_per, extra = divmod(len(source), n)
+        branch: dict = {}
+        offset = 0
+        for i in range(n):
+            size = base_per + (1 if i < extra else 0)
+            branch[letters[i]] = cls._build_node(
+                rng, source[offset : offset + size], max_branch_len, depth_remaining - 1
+            )
+            offset += size
+        return branch
+
+    # -- key enumeration (client.rs:1518-1549) ------------------------------
+
+    def key_indices(self, rng: random.Random) -> list:
+        """All (key, candidate_index) pairs, in shuffled presentation order.
+
+        Keys are backtick-quoted per level: depth 1 -> "`C`", depth 2 ->
+        "`C``B`".
+        """
+        pairs: list = []
+        self._collect(self.root, "", pairs)
+        rng.shuffle(pairs)
+        return pairs
+
+    @staticmethod
+    def _collect(node: Node, prefix: str, out: list) -> None:
+        if isinstance(node, int):
+            out.append((prefix, node))
+            return
+        for letter, child in node.items():
+            PrefixTree._collect(child, f"{prefix}`{letter}`", out)
+
+    # -- lookup --------------------------------------------------------------
+
+    def child(self, node: Node, letter: str) -> Optional[Node]:
+        if isinstance(node, dict):
+            return node.get(letter)
+        return None
+
+    def walk(self, key: str) -> dict:
+        """Descend to the lowest branch selected by ``key``'s letters.
+
+        Mirrors get_vote's descent (client.rs:1700-1716): consume alphabet
+        letters from the key until one level above the leaves; returns that
+        branch (letter -> leaf index).
+        """
+        node = self.root
+        remaining = self.depth - 1
+        if remaining > 0:
+            for c in key:
+                if c in ALPHABET:
+                    nxt = self.child(node, c)
+                    if nxt is None:
+                        break
+                    node = nxt
+                    remaining -= 1
+                    if remaining == 0:
+                        break
+        if not isinstance(node, dict):
+            raise ValueError("prefix tree walk ended on a leaf")
+        return node
+
+    # -- regex patterns (client.rs:1605-1630) -------------------------------
+
+    @staticmethod
+    def regex_patterns(keys: list) -> tuple:
+        """(with_ticks, without_ticks) alternation patterns over the keys.
+
+        Keys contain only letters and backticks — no regex metacharacters —
+        so they embed literally.  The stripped variant drops the outermost
+        backticks (tolerates models that eat the quoting).
+        """
+        with_ticks = "|".join(f"({k})" for k in keys)
+        without_ticks = "|".join(f"({k[1:-1]})" for k in keys)
+        return with_ticks, without_ticks
+
+
+def serialize_ballot(choices_texts: list, key_indices: list) -> str:
+    """Pretty JSON map of key -> candidate text, in shuffled ballot order.
+
+    Reference serializes the request ``Choice`` values, which are plain text
+    at this point in the engine (client.rs:1580-1603).
+    """
+    ordered = {key: choices_texts[idx] for key, idx in key_indices}
+    return jsonutil.dumps(ordered, pretty=True)

@@ -191,10 +191,15 @@ class ExpectedTwoOrMoreChoices(ScoreError):
 
 
 class InvalidContentError(ScoreError):
-    """No parseable ballot key in a judge's output."""
+    """No parseable ballot key in a judge's output.
 
-    def __init__(self):
+    ``detail`` refines the diagnostic for logs; the wire message stays the
+    reference's fixed string (score/completions/error.rs:12-13).
+    """
+
+    def __init__(self, detail: Optional[str] = None):
         super().__init__("invalid_content", "expected a valid response key", 500)
+        self.detail = detail
 
 
 class AllVotesFailed(ScoreError):

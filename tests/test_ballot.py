@@ -1,0 +1,287 @@
+"""Ballot tree + vote extraction tests (SURVEY §4: deterministic-RNG ballot
+tests — key<->candidate bijection, tree shape for N in {2,20,21,400}, regex
+round-trip, logprob soft-vote normalization, one-hot fallback)."""
+
+import math
+import random
+from dataclasses import dataclass, field
+from decimal import Decimal
+
+import pytest
+
+from llm_weighted_consensus_tpu.ballot import (
+    ALPHABET,
+    InvalidContentError,
+    PrefixTree,
+    ballot_instruction,
+    branch_limit,
+    extract_vote,
+    response_key_schema,
+    serialize_ballot,
+)
+
+
+@dataclass
+class TopLogprob:
+    token: str
+    logprob: float = None
+
+
+@dataclass
+class LogprobToken:
+    token: str
+    logprob: float = None
+    top_logprobs: list = field(default_factory=list)
+
+
+def make(n, max_branch=20, seed=0):
+    rng = random.Random(seed)
+    tree = PrefixTree.build(rng, n, max_branch)
+    pairs = tree.key_indices(rng)
+    return tree, pairs
+
+
+# -- tree shape ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [2, 5, 20, 21, 40, 400, 401])
+def test_bijection_and_uniform_depth(n):
+    tree, pairs = make(n)
+    assert len(pairs) == n
+    # bijection: every candidate exactly once, every key unique
+    assert sorted(idx for _, idx in pairs) == list(range(n))
+    assert len({k for k, _ in pairs}) == n
+    # uniform key length == depth * 3 (each level contributes `X`)
+    expected_len = tree.depth * 3
+    assert all(len(k) == expected_len for k, _ in pairs)
+
+
+@pytest.mark.parametrize(
+    "n,max_branch,depth",
+    [(2, 20, 1), (20, 20, 1), (21, 20, 2), (400, 20, 2), (401, 20, 3),
+     (2, 2, 1), (3, 2, 2), (4, 2, 2), (5, 2, 3), (8, 2, 3), (9, 2, 4)],
+)
+def test_depth(n, max_branch, depth):
+    tree, _ = make(n, max_branch)
+    assert tree.depth == depth
+
+
+def test_branch_limit():
+    assert branch_limit(None) == 20
+    assert branch_limit(0) == 20
+    assert branch_limit(1) == 20
+    assert branch_limit(2) == 2
+    assert branch_limit(20) == 20
+
+
+def test_shuffles_are_seeded_deterministic():
+    _, a = make(10, seed=7)
+    _, b = make(10, seed=7)
+    _, c = make(10, seed=8)
+    assert a == b
+    assert a != c  # vanishingly unlikely to collide
+
+
+def test_anti_position_bias():
+    # presentation order must not systematically equal candidate order
+    hits = 0
+    for seed in range(50):
+        _, pairs = make(6, seed=seed)
+        if [i for _, i in pairs] == list(range(6)):
+            hits += 1
+    assert hits <= 2
+
+
+# -- ballot serialization -----------------------------------------------------
+
+
+def test_serialize_ballot_order_and_shape():
+    _, pairs = make(3)
+    texts = ["alpha", "beta", "gamma"]
+    s = serialize_ballot(texts, pairs)
+    import json
+
+    obj = json.loads(s)
+    assert list(obj.keys()) == [k for k, _ in pairs]
+    assert [obj[k] for k, _ in pairs] == [texts[i] for _, i in pairs]
+    assert s.startswith("{\n")  # pretty-printed
+
+
+def test_instruction_prompt_lists_keys():
+    tree, pairs = make(3)
+    keys = [k for k, _ in pairs]
+    s = serialize_ballot(["a", "b", "c"], pairs)
+    text = ballot_instruction(s, keys, "instruction")
+    for k in keys:
+        assert f"- {k}" in text
+    assert "Output exactly one response key" in text
+    forced = ballot_instruction(s, keys, "json_schema")
+    assert "Output exactly one" not in forced
+
+
+def test_response_key_schema():
+    schema = response_key_schema(["`A`", "`B`"], False)
+    assert schema["properties"]["response_key"]["enum"] == ["`A`", "`B`"]
+    assert schema["required"] == ["response_key"]
+    think = response_key_schema(["`A`"], True)
+    assert think["required"] == ["_think", "response_key"]
+
+
+# -- vote extraction ----------------------------------------------------------
+
+
+def patterns(pairs):
+    return PrefixTree.regex_patterns([k for k, _ in pairs])
+
+
+@pytest.mark.parametrize("n", [2, 20, 21, 400])
+def test_one_hot_round_trip_every_key(n):
+    tree, pairs = make(n)
+    wt, wo = patterns(pairs)
+    for key, idx in pairs[: min(n, 25)]:
+        vote = extract_vote(tree, wt, wo, n, f"I choose {key}.")
+        assert vote[idx] == Decimal(1)
+        assert sum(vote) == Decimal(1)
+
+
+def test_last_match_wins():
+    tree, pairs = make(4)
+    wt, wo = patterns(pairs)
+    (k0, i0), (k1, i1) = pairs[0], pairs[1]
+    content = f"Maybe {k0}? On reflection the answer is {k1}"
+    vote = extract_vote(tree, wt, wo, 4, content)
+    assert vote[i1] == Decimal(1)
+
+
+def test_tick_stripped_fallback():
+    tree, pairs = make(3)
+    wt, wo = patterns(pairs)
+    key, idx = pairs[0]
+    stripped = key[1:-1]  # model ate the outer backticks
+    vote = extract_vote(tree, wt, wo, 3, f"answer: {stripped}")
+    assert vote[idx] == Decimal(1)
+
+
+def test_invalid_content():
+    tree, pairs = make(3)
+    wt, wo = patterns(pairs)
+    with pytest.raises(InvalidContentError):
+        extract_vote(tree, wt, wo, 3, "no key here")
+    with pytest.raises(InvalidContentError):
+        extract_vote(tree, wt, wo, 3, None)
+    with pytest.raises(InvalidContentError):
+        extract_vote(tree, wt, wo, 3, "")
+
+
+def test_soft_vote_from_logprobs():
+    tree, pairs = make(3)
+    wt, wo = patterns(pairs)
+    key, idx = pairs[0]
+    letter = key[1]
+    # which letters map to which candidates at the (single) branch level
+    branch = tree.walk(key)
+    siblings = [(c, i) for c, i in branch.items() if isinstance(i, int)]
+    top = [TopLogprob(token=c, logprob=math.log(0.2 + 0.1 * j))
+           for j, (c, _) in enumerate(siblings)]
+    tokens = [
+        LogprobToken(token="`"),
+        LogprobToken(token=letter, top_logprobs=top),
+        LogprobToken(token="`"),
+    ]
+    vote = extract_vote(tree, wt, wo, 3, f"the answer is {key}", tokens)
+    # normalized distribution over all siblings
+    assert abs(sum(vote) - Decimal(1)) < Decimal("1e-20")
+    assert all(v > 0 for v in vote)
+    raw = [0.2 + 0.1 * j for j in range(len(siblings))]
+    total = sum(raw)
+    for j, (_, cand) in enumerate(siblings):
+        assert float(vote[cand]) == pytest.approx(raw[j] / total, rel=1e-9)
+
+
+def test_soft_vote_multichar_token_alignment():
+    tree, pairs = make(2)
+    wt, wo = patterns(pairs)
+    key, idx = pairs[0]
+    letter = key[1]
+    other = next(k for k, _ in pairs if k != key)[1]
+    # single token carries the whole quoted key; alternatives are full keys too
+    tok = LogprobToken(
+        token=key,
+        top_logprobs=[
+            TopLogprob(token=key, logprob=math.log(0.75)),
+            TopLogprob(token=f"`{other}`", logprob=math.log(0.25)),
+        ],
+    )
+    vote = extract_vote(tree, wt, wo, 2, key, [tok])
+    assert float(vote[idx]) == pytest.approx(0.75)
+    assert float(sum(vote)) == pytest.approx(1.0)
+
+
+def test_soft_vote_alignment_reset_on_partial_match():
+    # a stray backtick earlier in the stream must not poison alignment
+    tree, pairs = make(2)
+    wt, wo = patterns(pairs)
+    key, idx = pairs[0]
+    letter = key[1]
+    top = [TopLogprob(token=letter, logprob=0.0)]
+    tokens = [
+        LogprobToken(token="`x"),  # partial-looking garbage
+        LogprobToken(token="`"),
+        LogprobToken(token=letter, top_logprobs=top),
+        LogprobToken(token="`"),
+    ]
+    vote = extract_vote(tree, wt, wo, 2, f"junk `x then {key}", tokens)
+    assert vote[idx] == Decimal(1)
+
+
+def test_soft_vote_falls_back_when_unalignable():
+    tree, pairs = make(2)
+    wt, wo = patterns(pairs)
+    key, idx = pairs[0]
+    tokens = [LogprobToken(token="unrelated")]
+    vote = extract_vote(tree, wt, wo, 2, key, tokens)
+    assert vote[idx] == Decimal(1)  # one-hot fallback
+
+
+def test_nested_tree_soft_vote_uses_lowest_branch():
+    # N=40, branch limit 5 -> split 5 x (2 x 4): depth 3; soft vote
+    # distributes only among the final-level siblings of the selected branch
+    rng = random.Random(3)
+    tree = PrefixTree.build(rng, 40, 5)
+    pairs = tree.key_indices(rng)
+    wt, wo = patterns(pairs)
+    key, idx = pairs[0]
+    assert tree.depth == 3 and len(key) == 9
+    branch = tree.walk(key)
+    final_letter = key[7]
+    assert branch[final_letter] == idx
+    top = [TopLogprob(token=c, logprob=math.log(0.5)) for c in branch]
+    tokens = [LogprobToken(token=key[:7]), LogprobToken(token=f"{final_letter}`", top_logprobs=top)]
+    vote = extract_vote(tree, wt, wo, 40, f"pick {key}", tokens)
+    nonzero = [i for i, v in enumerate(vote) if v > 0]
+    assert set(nonzero) == {i for i in branch.values()}
+    assert float(sum(vote)) == pytest.approx(1.0)
+
+
+def test_uniform_depth_sweep():
+    # regression: the reference's splitter mixes leaf depths for e.g.
+    # (N=9, limit=2) and then panics during vote extraction; ours must keep
+    # key length constant for every (N, limit) combination
+    for n in range(2, 60):
+        for mb in (2, 3, 5, 20):
+            rng = random.Random(n * 31 + mb)
+            tree = PrefixTree.build(rng, n, mb)
+            pairs = tree.key_indices(rng)
+            assert all(len(k) == tree.depth * 3 for k, _ in pairs), (n, mb)
+            wt, wo = PrefixTree.regex_patterns([k for k, _ in pairs])
+            key, idx = pairs[0]
+            vote = extract_vote(tree, wt, wo, n, f"pick {key}")
+            assert vote[idx] == Decimal(1)
+
+
+def test_unicode_in_stream():
+    tree, pairs = make(2)
+    wt, wo = patterns(pairs)
+    key, idx = pairs[0]
+    vote = extract_vote(tree, wt, wo, 2, f"café ✓ — choosing {key} ✓")
+    assert vote[idx] == Decimal(1)

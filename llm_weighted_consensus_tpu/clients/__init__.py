@@ -1,0 +1,8 @@
+"""IO shells: upstream chat client, consensus engine, multichat fan-out.
+
+These modules are the host-side orchestration layer (asyncio); they import
+the pure core but no JAX.  Device math is reached through the ``weights`` /
+``ops`` seams so the IO path stays importable everywhere.
+"""
+
+from . import chat  # noqa: F401

@@ -1,0 +1,452 @@
+"""Resilient asyncio SSE client for OpenAI-compatible chat APIs.
+
+Parity target: reference src/chat/completions/client.rs — ``DefaultClient``:
+
+* per-request ``CtxHandler`` hook that can rewrite the endpoint list
+  (client.rs:26-54);
+* archived-completion prefetch + message rehydration (client.rs:211-222,
+  437-645 — implemented in ``archive``);
+* forced streaming with ``include_usage`` when the caller wanted unary
+  (client.rs:230-236);
+* attempt matrix: primary model x every api_base, then each fallback model x
+  every api_base (client.rs:238-258);
+* retry under exponential backoff with first-chunk peek: a stream only
+  commits once its first chunk arrives (client.rs:263-304);
+* SSE decode with two-tier timeouts (first vs other chunk), ``[DONE]``
+  handling, OpenRouter error-shape fallback, JSON-path deserialization
+  errors, bad-status body capture (client.rs:334-434).
+
+Streams yield a union: ``ChatCompletionChunk`` items interleaved with
+``ChatError`` items (the reference's ``Result`` stream).  A yielded error
+does not necessarily end the stream — a malformed chunk yields an error and
+decoding continues — matching the reference exactly; consumers decide.
+
+Transport is a seam (``Transport``) so tests script byte streams without
+sockets; ``AiohttpTransport`` is the real implementation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass
+from typing import AsyncIterator, Optional
+
+from .. import archive as archive_mod
+from ..errors import (
+    BadStatusError,
+    ChatError,
+    CtxHandlerError,
+    DeserializationError,
+    EmptyStreamError,
+    ProviderError,
+    ResponseError,
+    StreamTimeoutError,
+    TransportError,
+)
+from ..types.base import SchemaError, fold_chunks
+from ..types.chat_request import ChatCompletionCreateParams, StreamOptions
+from ..types.chat_response import ChatCompletion, ChatCompletionChunk
+from ..utils import jsonutil
+from .sse import SSEParser
+
+DONE_FRAME = "[DONE]"
+
+
+@dataclass
+class ApiBase:
+    """One upstream endpoint (client.rs:13-17)."""
+
+    api_base: str
+    api_key: str
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "ApiBase":
+        return cls(api_base=obj["api_base"], api_key=obj["api_key"])
+
+
+@dataclass
+class BackoffPolicy:
+    """Exponential backoff (reference uses the ``backoff`` crate; defaults
+    from main.rs:5-16)."""
+
+    initial_interval_ms: float = 100.0
+    randomization_factor: float = 0.5
+    multiplier: float = 1.5
+    max_interval_ms: float = 1000.0
+    max_elapsed_ms: Optional[float] = 40000.0
+
+    def sleeps(self, rng: Optional[random.Random] = None):
+        """Yield sleep durations (seconds); stops when max_elapsed exceeded.
+
+        ``max_elapsed`` caps *wall-clock since the first attempt* (attempt
+        time included), matching the backoff crate's max_elapsed_time.
+        """
+        rng = rng or random
+        interval = self.initial_interval_ms
+        start = time.monotonic()
+        while True:
+            jittered = interval * (
+                1 + self.randomization_factor * (2 * rng.random() - 1)
+            )
+            if self.max_elapsed_ms is not None:
+                elapsed_ms = (time.monotonic() - start) * 1000.0
+                if elapsed_ms + jittered > self.max_elapsed_ms:
+                    return
+            yield jittered / 1000.0
+            interval = min(interval * self.multiplier, self.max_interval_ms)
+
+
+class CtxHandler:
+    """Per-request auth/routing hook (client.rs:26-54).
+
+    ``handle`` may rewrite the endpoint list per request context; raising
+    :class:`ResponseError` aborts the request as a ctx error.
+    """
+
+    async def handle(self, ctx, api_bases: list) -> list:
+        return api_bases
+
+
+# ---------------------------------------------------------------------------
+# Transport seam
+# ---------------------------------------------------------------------------
+
+
+class TransportResponse:
+    status: int = 0
+
+    async def read_body(self) -> bytes:
+        raise NotImplementedError
+
+    def byte_stream(self) -> AsyncIterator[bytes]:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class Transport:
+    async def post_sse(
+        self, url: str, headers: dict, body: bytes
+    ) -> TransportResponse:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class AiohttpTransport(Transport):
+    """Real HTTP transport; lazily creates one shared aiohttp session."""
+
+    def __init__(self) -> None:
+        self._session = None
+
+    def _get_session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            # no total timeout: streams are bounded by the client's own
+            # per-chunk timeouts
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30)
+            )
+        return self._session
+
+    async def post_sse(self, url, headers, body) -> TransportResponse:
+        session = self._get_session()
+        try:
+            resp = await session.post(
+                url,
+                data=body,
+                headers={**headers, "content-type": "application/json"},
+            )
+        except Exception as e:  # connection-level failure
+            raise TransportError(str(e)) from e
+
+        class _Resp(TransportResponse):
+            status = resp.status
+
+            async def read_body(self) -> bytes:
+                try:
+                    return await resp.read()
+                finally:
+                    resp.release()
+
+            async def byte_stream(self):
+                async for chunk in resp.content.iter_any():
+                    yield chunk
+
+            async def close(self) -> None:
+                resp.close()
+
+        return _Resp()
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+# ---------------------------------------------------------------------------
+# The client
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Attempt:
+    api_base: ApiBase
+    model: str
+
+
+class ChatClient:
+    """Abstract client interface (client.rs:56-79): the consensus engine and
+    the gateway depend on this, not on the HTTP implementation."""
+
+    async def create_streaming(self, ctx, params: ChatCompletionCreateParams):
+        raise NotImplementedError
+
+    async def create_unary(self, ctx, params) -> ChatCompletion:
+        stream = await self.create_streaming(ctx, params)
+        chunks = []
+        try:
+            async for item in stream:
+                if isinstance(item, ChatError):
+                    raise item
+                chunks.append(item)
+        finally:
+            aclose = getattr(stream, "aclose", None)
+            if aclose is not None:
+                await aclose()
+        aggregate = fold_chunks(chunks)
+        if aggregate is None:
+            raise EmptyStreamError()
+        return ChatCompletion.from_streaming(aggregate)
+
+
+class DefaultChatClient(ChatClient):
+    def __init__(
+        self,
+        transport: Transport,
+        api_bases: list,
+        *,
+        backoff: Optional[BackoffPolicy] = None,
+        user_agent: Optional[str] = None,
+        x_title: Optional[str] = None,
+        referer: Optional[str] = None,
+        first_chunk_timeout_ms: float = 10000.0,
+        other_chunk_timeout_ms: float = 60000.0,
+        ctx_handler: Optional[CtxHandler] = None,
+        archive_fetcher: Optional[archive_mod.Fetcher] = None,
+    ) -> None:
+        self.transport = transport
+        self.api_bases = list(api_bases)
+        self.backoff = backoff or BackoffPolicy()
+        self.user_agent = user_agent
+        self.x_title = x_title
+        self.referer = referer
+        self.first_chunk_timeout_ms = first_chunk_timeout_ms
+        self.other_chunk_timeout_ms = other_chunk_timeout_ms
+        self.ctx_handler = ctx_handler or CtxHandler()
+        self.archive_fetcher = archive_fetcher or archive_mod.UnimplementedFetcher()
+
+    # -- public API ---------------------------------------------------------
+
+    async def create_streaming(self, ctx, params):
+        stream, _api_base = await self.create_streaming_return_api_base(ctx, params)
+        return stream
+
+    async def create_streaming_return_api_base(self, ctx, params):
+        """Returns (stream, api_base_used); raises ChatError when every
+        attempt fails for the whole backoff budget (client.rs:193-306)."""
+        # concurrently: ctx hook + archive prefetch
+        async def _handle_ctx():
+            try:
+                return await self.ctx_handler.handle(ctx, list(self.api_bases))
+            except ResponseError as e:
+                raise CtxHandlerError(e) from e
+
+        # join with cancellation (tokio::try_join! semantics): first failure
+        # cancels the sibling so an aborted request does no stray archive IO
+        api_bases, completions = await _try_join(
+            _handle_ctx(),
+            archive_mod.fetch_archived_for_messages(
+                self.archive_fetcher, ctx, params.messages
+            ),
+        )
+
+        request = params.clone()
+        request.messages = archive_mod.replace_archive_messages(
+            completions, request.messages
+        )
+
+        # force streaming (+usage when the caller wanted unary)
+        if not request.stream:
+            request.stream_options = StreamOptions(include_usage=True)
+        request.stream = True
+
+        # attempt matrix: primary model x ctx api_bases, then each fallback
+        # model x the configured api_bases (client.rs:238-258)
+        attempts = [_Attempt(ab, request.model) for ab in api_bases]
+        if request.models:
+            for model in request.models:
+                attempts.extend(_Attempt(ab, model) for ab in self.api_bases)
+            request.models = None
+        if not attempts:
+            raise TransportError("no api endpoints to attempt", 500)
+
+        last_error: Optional[ChatError] = None
+        sleeps = self.backoff.sleeps()
+        while True:
+            for i, attempt in enumerate(attempts):
+                request.model = attempt.model
+                stream = self._open_event_stream(attempt.api_base, request)
+                # first-chunk peek: commit only on a good first chunk
+                try:
+                    first = await stream.__anext__()
+                except StopAsyncIteration:
+                    first = EmptyStreamError()
+                if isinstance(first, ChatError):
+                    last_error = first
+                    await stream.aclose()
+                    continue
+                return _prepend(first, stream), attempt.api_base
+            sleep = next(sleeps, None)
+            if sleep is None:
+                raise last_error if last_error is not None else EmptyStreamError()
+            await asyncio.sleep(sleep)
+
+    # -- stream machinery ---------------------------------------------------
+
+    def _headers(self, api_base: ApiBase) -> dict:
+        headers = {
+            "authorization": f"Bearer {api_base.api_key}",
+            "accept": "text/event-stream",
+        }
+        if self.user_agent:
+            headers["user-agent"] = self.user_agent
+        if self.x_title:
+            headers["x-title"] = self.x_title
+        if self.referer:
+            headers["referer"] = self.referer
+            headers["http-referer"] = self.referer
+        return headers
+
+    async def _open_event_stream(self, api_base: ApiBase, request):
+        """Async generator yielding ChatCompletionChunk | ChatError items.
+
+        Mirrors create_streaming_stream (client.rs:334-434).  Decode errors
+        for individual frames yield an error item and keep going; transport
+        errors, bad statuses and timeouts yield an error item and stop.
+        """
+        url = f"{api_base.api_base.rstrip('/')}/chat/completions"
+        body = jsonutil.dumps(request.to_json_obj()).encode("utf-8")
+        try:
+            resp = await self.transport.post_sse(url, self._headers(api_base), body)
+        except ChatError as e:
+            yield e
+            return
+        except Exception as e:
+            yield TransportError(str(e))
+            return
+
+        try:
+            if not (200 <= resp.status < 300):
+                try:
+                    raw = await asyncio.wait_for(
+                        resp.read_body(), self.first_chunk_timeout_ms / 1000.0
+                    )
+                except asyncio.TimeoutError:
+                    yield StreamTimeoutError()
+                    return
+                try:
+                    parsed = jsonutil.loads(raw.decode("utf-8", errors="replace"))
+                except Exception:
+                    parsed = raw.decode("utf-8", errors="replace")
+                yield BadStatusError(resp.status, parsed)
+                return
+
+            parser = SSEParser()
+            byte_iter = resp.byte_stream().__aiter__()
+            first = True
+            pending: list = []
+            while True:
+                # per-chunk timeout tiers (client.rs:334-354; defaults
+                # main.rs:17-20)
+                if not pending:
+                    timeout = (
+                        self.first_chunk_timeout_ms
+                        if first
+                        else self.other_chunk_timeout_ms
+                    ) / 1000.0
+                    try:
+                        data = await asyncio.wait_for(
+                            byte_iter.__anext__(), timeout
+                        )
+                    except StopAsyncIteration:
+                        tail = parser.flush()
+                        if tail is not None and tail != DONE_FRAME:
+                            pending.append(tail)
+                        if not pending:
+                            return
+                        data = None
+                    except asyncio.TimeoutError:
+                        yield StreamTimeoutError()
+                        return
+                    except Exception as e:
+                        yield TransportError(str(e))
+                        return
+                    if data is not None:
+                        pending.extend(parser.feed(data))
+                        continue
+                event = pending.pop(0)
+                first = False
+                if event == DONE_FRAME:
+                    return
+                if not event or event.startswith(":"):
+                    continue
+                item = self._decode_chunk(event)
+                yield item
+        finally:
+            await resp.close()
+
+    @staticmethod
+    def _decode_chunk(data: str):
+        try:
+            obj = jsonutil.loads(data)
+        except Exception as e:
+            return DeserializationError(f"invalid JSON: {e}")
+        try:
+            chunk = ChatCompletionChunk.from_json_obj(obj)
+            chunk.with_total_cost()
+            return chunk
+        except SchemaError as e:
+            # OpenRouter provider-error passthrough (error.rs:99-141)
+            if isinstance(obj, dict) and isinstance(obj.get("error"), dict):
+                inner = obj["error"]
+                return ProviderError(
+                    code=inner.get("code"),
+                    message=inner.get("message"),
+                    metadata=inner.get("metadata"),
+                    user_id=obj.get("user_id"),
+                )
+            return DeserializationError(str(e))
+
+
+async def _try_join(*coros):
+    """asyncio.gather with sibling cancellation on first failure."""
+    tasks = [asyncio.ensure_future(c) for c in coros]
+    try:
+        return await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
+
+
+async def _prepend(first, rest):
+    """StreamOnce(first).chain(rest) (util.rs:33-53, client.rs:281-302)."""
+    yield first
+    async for item in rest:
+        yield item

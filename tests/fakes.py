@@ -1,0 +1,105 @@
+"""Fake upstream provider harness (SURVEY §4: scripted SSE chunk sequences,
+timeouts, mid-stream errors, OpenRouter error bodies)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from llm_weighted_consensus_tpu.clients.chat import Transport, TransportResponse
+
+
+def chunk_obj(
+    content: Optional[str] = None,
+    *,
+    cid: str = "cc-1",
+    model: str = "fake-model",
+    index: int = 0,
+    finish: Optional[str] = None,
+    usage: Optional[dict] = None,
+    role: Optional[str] = None,
+    logprobs: Optional[dict] = None,
+    created: int = 1700000000,
+) -> dict:
+    delta: dict = {}
+    if role is not None:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    choice: dict = {"index": index, "delta": delta, "finish_reason": finish}
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
+    obj: dict = {
+        "id": cid,
+        "object": "chat.completion.chunk",
+        "created": created,
+        "model": model,
+        "choices": [choice],
+    }
+    if usage is not None:
+        obj["usage"] = usage
+    return obj
+
+
+def sse_frames(events: list) -> bytes:
+    """Encode a list of event payloads (dict -> json, str -> raw) as SSE."""
+    out = []
+    for ev in events:
+        data = json.dumps(ev) if isinstance(ev, dict) else ev
+        out.append(f"data: {data}\n\n")
+    return "".join(out).encode()
+
+
+class Script:
+    """One scripted upstream response."""
+
+    def __init__(
+        self,
+        events: Optional[list] = None,
+        *,
+        status: int = 200,
+        body: Optional[bytes] = None,
+        connect_error: Optional[Exception] = None,
+        delays: Optional[dict] = None,
+        done: bool = True,
+    ):
+        self.events = list(events or [])
+        self.status = status
+        self.body = body
+        self.connect_error = connect_error
+        self.delays = delays or {}  # frame index -> seconds
+        self.done = done
+
+
+class FakeTransport(Transport):
+    """Pops one Script per request; records every request it served."""
+
+    def __init__(self, scripts: list):
+        self.scripts = list(scripts)
+        self.requests: list = []  # (url, headers, body_obj)
+
+    async def post_sse(self, url, headers, body) -> TransportResponse:
+        self.requests.append((url, headers, json.loads(body)))
+        if not self.scripts:
+            raise AssertionError(f"unexpected request to {url}")
+        script = self.scripts.pop(0)
+        if script.connect_error is not None:
+            raise script.connect_error
+
+        class _Resp(TransportResponse):
+            status = script.status
+
+            async def read_body(self) -> bytes:
+                return script.body or b""
+
+            async def byte_stream(self):
+                for i, ev in enumerate(script.events):
+                    delay = script.delays.get(i)
+                    if delay:
+                        await asyncio.sleep(delay)
+                    yield sse_frames([ev])
+                if script.done:
+                    yield b"data: [DONE]\n\n"
+
+        return _Resp()

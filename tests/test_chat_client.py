@@ -1,0 +1,325 @@
+"""Chat SSE client behavior: attempt matrix, first-chunk peek, backoff,
+timeouts, error taxonomy, archive rehydration (SURVEY §2.2, §4)."""
+
+import asyncio
+
+import pytest
+
+from llm_weighted_consensus_tpu import archive
+from llm_weighted_consensus_tpu.clients.chat import (
+    ApiBase,
+    BackoffPolicy,
+    CtxHandler,
+    DefaultChatClient,
+)
+from llm_weighted_consensus_tpu.clients.sse import SSEParser
+from llm_weighted_consensus_tpu.errors import (
+    BadStatusError,
+    ProviderError,
+    StreamTimeoutError,
+    TransportError,
+)
+from llm_weighted_consensus_tpu.types.chat_request import (
+    ChatCompletionCreateParams,
+    UserMessage,
+)
+from llm_weighted_consensus_tpu.types.chat_response import ChatCompletion
+
+from fakes import FakeTransport, Script, chunk_obj
+
+AB = [ApiBase("https://a.example", "key-a"), ApiBase("https://b.example", "key-b")]
+FAST = BackoffPolicy(initial_interval_ms=1, max_interval_ms=2, max_elapsed_ms=10)
+NO_RETRY = BackoffPolicy(max_elapsed_ms=0)
+
+
+def client(scripts, api_bases=None, **kw):
+    transport = FakeTransport(scripts)
+    kw.setdefault("backoff", FAST)
+    return (
+        DefaultChatClient(transport, api_bases or AB[:1], **kw),
+        transport,
+    )
+
+
+def params(**kw):
+    kw.setdefault("messages", [UserMessage(content="hi")])
+    kw.setdefault("model", "fake-model")
+    return ChatCompletionCreateParams(**kw)
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# -- SSE parser ---------------------------------------------------------------
+
+
+def test_sse_parser_frames():
+    p = SSEParser()
+    events = list(p.feed(b'data: {"a":1}\n\ndata: x\ndata: y\n\n: comment\n\n'))
+    assert events == ['{"a":1}', "x\ny"]
+
+
+def test_sse_parser_crlf_and_split_feeds():
+    p = SSEParser()
+    out = []
+    for b in (b"data: he", b"llo\r", b"\n\r\n", b"data: [DONE]\n\n"):
+        out.extend(p.feed(b))
+    assert out == ["hello", "[DONE]"]
+
+
+def test_sse_parser_flush():
+    p = SSEParser()
+    assert list(p.feed(b"data: tail\n")) == []
+    assert p.flush() == "tail"
+    assert p.flush() is None
+
+
+# -- streaming + unary --------------------------------------------------------
+
+
+def test_unary_is_fold_of_stream():
+    c, t = client(
+        [
+            Script(
+                [
+                    chunk_obj("Hel", role="assistant"),
+                    chunk_obj("lo"),
+                    chunk_obj(finish="stop", usage={"prompt_tokens": 3, "completion_tokens": 2, "total_tokens": 5}),
+                ]
+            )
+        ]
+    )
+    result = go(c.create_unary(None, params()))
+    assert isinstance(result, ChatCompletion)
+    assert result.choices[0].message.content == "Hello"
+    assert result.choices[0].finish_reason == "stop"
+    assert result.usage.total_tokens == 5
+    # unary request forces stream + include_usage (client.rs:230-236)
+    _, _, body = t.requests[0]
+    assert body["stream"] is True
+    assert body["stream_options"] == {"include_usage": True}
+
+
+def test_streaming_yields_chunks_and_auth_headers():
+    c, t = client([Script([chunk_obj("x")])])
+    items = go(_stream_items(c))
+    assert [i.choices[0].delta.content for i in items] == ["x"]
+    url, headers, _ = t.requests[0]
+    assert url == "https://a.example/chat/completions"
+    assert headers["authorization"] == "Bearer key-a"
+
+
+async def _stream_items(c, p=None):
+    stream = await c.create_streaming(None, p or params())
+    return [item async for item in stream]
+
+
+# -- attempt matrix -----------------------------------------------------------
+
+
+def test_attempt_matrix_falls_through_api_bases():
+    c, t = client(
+        [Script(status=500, body=b'{"oops":1}'), Script([chunk_obj("ok")])],
+        api_bases=AB,
+    )
+    items = go(_stream_items(c))
+    assert items[0].choices[0].delta.content == "ok"
+    assert [u for u, _, _ in t.requests] == [
+        "https://a.example/chat/completions",
+        "https://b.example/chat/completions",
+    ]
+
+
+def test_attempt_matrix_fallback_models():
+    # primary model fails on both bases; fallback model succeeds on first
+    c, t = client(
+        [Script(status=500), Script(status=500), Script([chunk_obj("fb")])],
+        api_bases=AB,
+    )
+    items = go(_stream_items(c, params(models=["backup-model"])))
+    assert items[0].choices[0].delta.content == "fb"
+    bodies = [b for _, _, b in t.requests]
+    assert [b["model"] for b in bodies] == ["fake-model", "fake-model", "backup-model"]
+    # fallback list not forwarded upstream (client.rs:249-258 takes models)
+    assert all("models" not in b for b in bodies)
+
+
+def test_first_chunk_peek_moves_to_next_attempt():
+    # first attempt connects but the first frame is garbage -> next attempt
+    c, t = client(
+        [Script(["not json"]), Script([chunk_obj("good")])], api_bases=AB
+    )
+    items = go(_stream_items(c))
+    assert items[0].choices[0].delta.content == "good"
+    assert len(t.requests) == 2
+
+
+def test_backoff_retries_then_raises_last_error():
+    scripts = [Script(status=503, body=b"busy") for _ in range(20)]
+    c, t = client(scripts, api_bases=AB[:1], backoff=BackoffPolicy(
+        initial_interval_ms=1, max_interval_ms=1, max_elapsed_ms=3))
+    with pytest.raises(BadStatusError) as ei:
+        go(_stream_items(c))
+    assert ei.value.status() == 503
+    assert len(t.requests) >= 2  # retried at least once
+
+
+def test_no_retry_budget_zero():
+    c, t = client([Script(connect_error=TransportError("refused"))],
+                  backoff=NO_RETRY)
+    with pytest.raises(TransportError):
+        go(_stream_items(c))
+    assert len(t.requests) == 1
+
+
+# -- stream error taxonomy ----------------------------------------------------
+
+
+def test_provider_error_mid_stream_yields_and_continues():
+    c, _ = client(
+        [
+            Script(
+                [
+                    chunk_obj("a"),
+                    {"error": {"code": 429, "message": "rate limited", "metadata": {"p": "x"}}},
+                    chunk_obj("b"),
+                ]
+            )
+        ]
+    )
+    items = go(_stream_items(c))
+    assert items[0].choices[0].delta.content == "a"
+    assert isinstance(items[1], ProviderError)
+    assert items[1].status() == 429
+    assert items[2].choices[0].delta.content == "b"
+
+
+def test_bad_status_body_captured():
+    c, _ = client([Script(status=418, body=b'{"detail":"teapot"}')],
+                  backoff=NO_RETRY)
+    with pytest.raises(BadStatusError) as ei:
+        go(_stream_items(c))
+    assert ei.value.status() == 418
+    assert ei.value.error == {"detail": "teapot"}
+
+
+def test_first_chunk_timeout():
+    c, _ = client(
+        [Script([chunk_obj("late")], delays={0: 0.2})],
+        backoff=NO_RETRY,
+        first_chunk_timeout_ms=20,
+    )
+    with pytest.raises(StreamTimeoutError):
+        go(_stream_items(c))
+
+
+def test_other_chunk_timeout_yields_mid_stream():
+    c, _ = client(
+        [Script([chunk_obj("a"), chunk_obj("slow")], delays={1: 0.2})],
+        backoff=NO_RETRY,
+        first_chunk_timeout_ms=5000,
+        other_chunk_timeout_ms=20,
+    )
+    items = go(_stream_items(c))
+    assert items[0].choices[0].delta.content == "a"
+    assert isinstance(items[-1], StreamTimeoutError)
+
+
+def test_done_comments_and_empty_frames():
+    c, _ = client([Script([chunk_obj("x"), ": keepalive", ""])])
+    items = go(_stream_items(c))
+    assert len(items) == 1  # comments/empties skipped, [DONE] terminates
+
+
+# -- ctx handler + archive ----------------------------------------------------
+
+
+def test_ctx_handler_rewrites_api_bases():
+    class Rewriter(CtxHandler):
+        async def handle(self, ctx, api_bases):
+            return [ApiBase("https://ctx.example", f"key-{ctx}")]
+
+    c, t = client([Script([chunk_obj("ok")])], ctx_handler=Rewriter())
+    go(_stream_items(c))
+    url, headers, _ = t.requests[0]
+    assert url == "https://ctx.example/chat/completions"
+    assert headers["authorization"] == "Bearer key-None"
+
+
+def test_archive_rehydration_in_request():
+    store = archive.InMemoryArchive()
+    store.put_chat(
+        ChatCompletion.from_json_obj(
+            {
+                "id": "cc-old",
+                "object": "chat.completion",
+                "created": 1,
+                "model": "m",
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": "archived answer", "refusal": None},
+                        "finish_reason": "stop",
+                    }
+                ],
+            }
+        )
+    )
+    c, t = client([Script([chunk_obj("ok")])], archive_fetcher=store)
+    p = ChatCompletionCreateParams.from_json_obj(
+        {
+            "model": "fake-model",
+            "messages": [
+                {"role": "user", "content": "hi"},
+                {"role": "chat_completion", "id": "cc-old", "choice_index": 0},
+            ],
+        }
+    )
+    go(_stream_items(c, p))
+    _, _, body = t.requests[0]
+    assert body["messages"][1] == {
+        "role": "assistant",
+        "content": "archived answer",
+    }
+
+
+def test_archive_invalid_choice_index():
+    store = archive.InMemoryArchive()
+    store.put_chat(
+        ChatCompletion.from_json_obj(
+            {
+                "id": "cc-old",
+                "object": "chat.completion",
+                "created": 1,
+                "model": "m",
+                "choices": [],
+            }
+        )
+    )
+    c, _ = client([], archive_fetcher=store)
+    p = ChatCompletionCreateParams.from_json_obj(
+        {
+            "model": "fake-model",
+            "messages": [{"role": "chat_completion", "id": "cc-old", "choice_index": 3}],
+        }
+    )
+    from llm_weighted_consensus_tpu.errors import InvalidCompletionChoiceIndex
+
+    with pytest.raises(InvalidCompletionChoiceIndex):
+        go(_stream_items(c, p))
+
+
+def test_archive_fetch_error_wrapped():
+    from llm_weighted_consensus_tpu.errors import ArchiveFetchError
+
+    c, _ = client([])
+    p = ChatCompletionCreateParams.from_json_obj(
+        {
+            "model": "fake-model",
+            "messages": [{"role": "chat_completion", "id": "nope"}],
+        }
+    )
+    with pytest.raises(ArchiveFetchError) as ei:
+        go(_stream_items(c, p))
+    assert ei.value.status() == 501  # unimplemented fetcher

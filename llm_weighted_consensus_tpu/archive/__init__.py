@@ -119,7 +119,7 @@ _MESSAGE_KIND = {
 }
 
 
-def _fetch_fn(fetcher: Fetcher, kind: str):
+def fetch_fn(fetcher: Fetcher, kind: str):
     return {
         KIND_CHAT: fetcher.fetch_chat_completion,
         KIND_SCORE: fetcher.fetch_score_completion,
@@ -127,32 +127,43 @@ def _fetch_fn(fetcher: Fetcher, kind: str):
     }[kind]
 
 
-async def fetch_archived_for_messages(
-    fetcher: Fetcher, ctx, messages: list
-) -> dict:
-    """Concurrently fetch every unique archived completion referenced by
-    archive-role messages; returns {id: (kind, completion)}.
-
-    Mirrors fetch_completion_futs_from_messages (chat client.rs:437-514):
-    one future per unique id, all awaited together.
-    """
-    wanted: list = []
-    seen = set()
+def message_refs(messages: list, seen: set) -> list:
+    """Unique (id, kind) pairs referenced by archive-role messages."""
+    refs = []
     for message in messages:
         kind = _MESSAGE_KIND.get(type(message))
         if kind is None or message.id in seen:
             continue
         seen.add(message.id)
-        wanted.append((message.id, kind))
-    if not wanted:
+        refs.append((message.id, kind))
+    return refs
+
+
+async def fetch_archived(
+    fetcher: Fetcher, ctx, refs: list, error_cls=None
+) -> dict:
+    """Concurrently fetch archived completions for (id, kind) pairs;
+    returns {id: (kind, completion)}.
+
+    Mirrors fetch_completion_futs_from_messages (chat client.rs:437-514):
+    one future per unique id, all awaited together; ``error_cls`` wraps
+    ResponseError failures (chat vs score error envelope).
+    """
+    if not refs:
         return {}
     try:
         completions = await asyncio.gather(
-            *(_fetch_fn(fetcher, kind)(ctx, cid) for cid, kind in wanted)
+            *(fetch_fn(fetcher, kind)(ctx, cid) for cid, kind in refs)
         )
     except ResponseError as e:
-        raise ArchiveFetchError(e) from e
-    return {cid: (kind, c) for (cid, kind), c in zip(wanted, completions)}
+        raise (error_cls or ArchiveFetchError)(e) from e
+    return {cid: (kind, c) for (cid, kind), c in zip(refs, completions)}
+
+
+async def fetch_archived_for_messages(
+    fetcher: Fetcher, ctx, messages: list
+) -> dict:
+    return await fetch_archived(fetcher, ctx, message_refs(messages, set()))
 
 
 def completion_choice_message(kind: str, completion, choice_index: int):

@@ -1,0 +1,673 @@
+"""Consensus engine: streaming protocol invariants, tally math, error
+isolation (SURVEY §2.6-2.7, §4 golden streaming transcripts)."""
+
+import asyncio
+import math
+import random
+from decimal import Decimal
+
+import pytest
+
+from llm_weighted_consensus_tpu import archive, registry
+from llm_weighted_consensus_tpu.ballot import PrefixTree, branch_limit
+from llm_weighted_consensus_tpu.clients.chat import (
+    ApiBase,
+    BackoffPolicy,
+    DefaultChatClient,
+)
+from llm_weighted_consensus_tpu.clients.score import ScoreClient
+from llm_weighted_consensus_tpu.errors import (
+    AllVotesFailed,
+    ExpectedTwoOrMoreChoices,
+    InvalidModelError,
+    ScoreError,
+)
+from llm_weighted_consensus_tpu.identity.model import ModelBase
+from llm_weighted_consensus_tpu.types.score_request import (
+    ChatCompletionCreateParams as ScoreParams,
+)
+from llm_weighted_consensus_tpu.types.score_response import (
+    ChatCompletionChunk,
+    TrainingTableData,
+)
+
+from fakes import FakeTransport, Script, chunk_obj
+
+SEED = 42
+# no retries: each judge makes exactly one upstream attempt so scripted
+# transports stay aligned with judges
+FAST = BackoffPolicy(max_elapsed_ms=0)
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def make_model(judges):
+    return ModelBase.from_json_obj({"llms": judges}).into_model_validate()
+
+
+def make_client(scripts, model_registry=None, store=None, **kw):
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "key")], backoff=FAST
+    )
+    client = ScoreClient(
+        chat,
+        model_registry or registry.InMemoryModelRegistry(),
+        archive_fetcher=store or archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+        **kw,
+    )
+    return client, transport
+
+
+def ballot_keys(n, top_logprobs=None):
+    """Replay the seeded ballot: candidate index -> key."""
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, n, branch_limit(top_logprobs))
+    return {idx: key for key, idx in tree.key_indices(rng)}
+
+
+def score_params(choices, model, **kw):
+    return ScoreParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "pick the best"}],
+            "model": model,
+            "choices": choices,
+            **kw,
+        }
+    )
+
+
+async def collect(client, params):
+    stream = await client.create_streaming(None, params)
+    return [item async for item in stream]
+
+
+TEXTS = ["answer alpha", "answer beta", "answer gamma"]
+
+
+def two_judge_model():
+    return make_model(
+        [
+            {"model": "judge-a", "weight": {"type": "static", "weight": 2}},
+            {"model": "judge-b", "weight": {"type": "static", "weight": 1}},
+        ]
+    )
+
+
+def judge_script(key, usage=None, model="up-model"):
+    return Script(
+        [
+            chunk_obj("I pick ", model=model),
+            chunk_obj(f"{key} as best.", model=model, finish="stop",
+                      usage=usage),
+        ]
+    )
+
+
+def inline_model_json(model):
+    # structured body accepted directly (request.rs:42-47)
+    return {"llms": [llm.base.to_json_obj() for llm in model.llms]}
+
+
+# -- protocol golden path -----------------------------------------------------
+
+
+def test_streaming_protocol_agreement():
+    model = two_judge_model()
+    keys = ballot_keys(3)
+    scripts = [judge_script(keys[1]), judge_script(keys[1])]
+    client, t = make_client(scripts)
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+
+    # initial chunk: all candidates, finished, in request order
+    first = items[0]
+    assert isinstance(first, ChatCompletionChunk)
+    assert [c.index for c in first.choices] == [0, 1, 2]
+    assert [c.delta.content for c in first.choices] == TEXTS
+    assert all(c.finish_reason == "stop" for c in first.choices)
+    assert first.id.startswith("scrcpl-")
+    assert first.model == model.id
+
+    # judge chunks: global indices >= 3, judge identity attached
+    judge_chunks = items[1:-1]
+    assert judge_chunks
+    for chunk in judge_chunks:
+        for c in chunk.choices:
+            assert c.index >= 3
+            assert c.model in {l.id for l in model.llms}
+            assert c.weight in (Decimal(2), Decimal(1))
+
+    # exactly one final aggregate frame with weights/confidences
+    final = items[-1]
+    assert final.weight_data is not None
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    assert cand[1].weight == Decimal(3)  # 2*1 + 1*1
+    assert cand[1].confidence == Decimal(1)
+    assert cand[0].weight == cand[2].weight == Decimal(0)
+    # judge choices: vote cleared, confidence = selected candidate share
+    for c in final.choices:
+        if c.index >= 3:
+            assert c.delta.vote is None
+            assert c.confidence == Decimal(1)
+            assert c.delta.content is None
+            assert c.finish_reason is None
+    # every judge's last streamed frame (before final) carried its vote
+    votes_seen = [
+        c.delta.vote
+        for chunk in judge_chunks
+        for c in chunk.choices
+        if c.delta.vote is not None
+    ]
+    assert len(votes_seen) == 2
+    assert all(v[1] == Decimal(1) for v in votes_seen)
+
+
+def test_disagreement_confidence_split():
+    model = two_judge_model()
+    keys = ballot_keys(3)
+    # judge-a (weight 2) -> candidate 0; judge-b (weight 1) -> candidate 2
+    by_model = {"judge-a": keys[0], "judge-b": keys[2]}
+    client, t = make_client([Script([]), Script([])])
+    # assign scripts by upstream model name: build scripts lazily per request
+    order = [llm.base.model for llm in model.llms]
+    t.scripts = [judge_script(by_model[m]) for m in order]
+    result = go(
+        client.create_unary(None, score_params(TEXTS, inline_model_json(model)))
+    )
+    cand = {c.index: c for c in result.choices if c.index < 3}
+    assert cand[0].weight == Decimal(2)
+    assert cand[2].weight == Decimal(1)
+    assert cand[0].confidence == Decimal(2) / Decimal(3)
+    assert cand[2].confidence == Decimal(1) / Decimal(3)
+    assert cand[1].confidence == Decimal(0)
+    # judge confidences equal the share of their selected candidate
+    judge = {c.model_index: c for c in result.choices if c.index >= 3}
+    a_index = next(l.index for l in model.llms if l.base.model == "judge-a")
+    assert judge[a_index].confidence == Decimal(2) / Decimal(3)
+
+
+def test_usage_accumulation_and_final_frame_only():
+    model = two_judge_model()
+    keys = ballot_keys(3)
+    usage = {"prompt_tokens": 10, "completion_tokens": 5, "total_tokens": 15}
+    client, t = make_client(
+        [judge_script(keys[0], usage=usage), judge_script(keys[0], usage=usage)]
+    )
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    final = items[-1]
+    assert final.usage.total_tokens == 30
+    # interim chunks carry no usage (stripped into the final total)
+    for chunk in items[:-1]:
+        assert chunk.usage is None
+        for c in chunk.choices:
+            if c.completion_metadata is not None:
+                assert c.completion_metadata.usage is None
+
+
+def test_trailing_usage_only_chunk_counted():
+    # OpenAI include_usage style: final chunk has empty choices + usage
+    model = make_model([{"model": "judge-a"}])
+    # single-judge model is valid (1-128); 2 candidates
+    keys = ballot_keys(2)
+    script = Script(
+        [
+            chunk_obj(f"pick {keys[0]}", finish="stop"),
+            {
+                "id": "cc-1",
+                "object": "chat.completion.chunk",
+                "created": 1,
+                "model": "up",
+                "choices": [],
+                "usage": {"prompt_tokens": 7, "completion_tokens": 3, "total_tokens": 10},
+            },
+        ]
+    )
+    client, _ = make_client([script])
+    result = go(
+        client.create_unary(
+            None, score_params(["a", "b"], inline_model_json(model))
+        )
+    )
+    assert result.usage.total_tokens == 10
+
+
+# -- error isolation ----------------------------------------------------------
+
+
+def test_judge_failure_is_error_choice_not_request_failure():
+    model = two_judge_model()
+    keys = ballot_keys(3)
+    order = [llm.base.model for llm in model.llms]
+    scripts = {
+        "judge-a": Script(status=500, body=b'{"err":"down"}'),
+        "judge-b": judge_script(keys[1]),
+    }
+    client, t = make_client([scripts[m] for m in order])
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert not any(isinstance(i, ScoreError) for i in items)
+    final = items[-1]
+    error_choices = [
+        c for item in items[:-1] for c in item.choices
+        if c.error is not None
+    ]
+    assert len(error_choices) == 1
+    assert error_choices[0].finish_reason == "error"
+    # surviving judge decides alone
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    assert cand[1].confidence == Decimal(1)
+
+
+def test_all_votes_failed_with_code_folding():
+    model = two_judge_model()
+    client, _ = make_client(
+        [
+            Script(status=404, body=b"{}"),
+            Script(status=422, body=b"{}"),
+        ]
+    )
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert isinstance(items[-1], AllVotesFailed)
+    assert items[-1].status() == 400  # two distinct 4xx fold to 400
+    # final aggregate frame still precedes the error item
+    assert isinstance(items[-2], ChatCompletionChunk)
+    assert items[-2].weight_data is not None
+
+
+def test_all_votes_failed_5xx():
+    model = two_judge_model()
+    client, _ = make_client(
+        [Script(status=404, body=b"{}"), Script(status=503, body=b"{}")]
+    )
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert items[-1].status() == 500
+
+
+def test_invalid_ballot_content_is_invalid_content_error():
+    model = make_model([{"model": "judge-a"}])
+    client, _ = make_client([Script([chunk_obj("no key here", finish="stop")])])
+    items = go(collect(client, score_params(["a", "b"], inline_model_json(model))))
+    assert isinstance(items[-1], AllVotesFailed)
+    errs = [
+        c.error
+        for item in items
+        if isinstance(item, ChatCompletionChunk)
+        for c in item.choices
+        if c.error is not None
+    ]
+    assert errs and errs[0].code == 500
+
+
+# -- request validation -------------------------------------------------------
+
+
+def test_less_than_two_choices_rejected():
+    model = make_model([{"model": "judge-a"}])
+    client, _ = make_client([])
+    with pytest.raises(ExpectedTwoOrMoreChoices):
+        go(collect(client, score_params(["only one"], inline_model_json(model))))
+
+
+def test_model_id_fetch_and_slug():
+    model = two_judge_model()
+    reg = registry.InMemoryModelRegistry()
+    reg.put(model)
+    keys = ballot_keys(3)
+    for ref in (model.id, f"author/{model.id}"):
+        client, _ = make_client(
+            [judge_script(keys[0]), judge_script(keys[0])], model_registry=reg
+        )
+        result = go(client.create_unary(None, score_params(TEXTS, ref)))
+        assert result.model == model.id
+
+
+def test_inline_json_string_model():
+    from llm_weighted_consensus_tpu.utils import jsonutil
+
+    model = two_judge_model()
+    keys = ballot_keys(3)
+    client, _ = make_client([judge_script(keys[0]), judge_script(keys[0])])
+    result = go(
+        client.create_unary(
+            None,
+            score_params(TEXTS, jsonutil.dumps(inline_model_json(model))),
+        )
+    )
+    assert result.model == model.id
+
+
+def test_invalid_model_rejected():
+    client, _ = make_client([])
+    with pytest.raises(InvalidModelError):
+        go(collect(client, score_params(TEXTS, "not json not id")))
+
+
+# -- ballot prompt + output forcing (upstream request shape) ------------------
+
+
+def test_ballot_injected_into_new_system_message():
+    model = make_model([{"model": "judge-a"}])
+    keys = ballot_keys(2)
+    client, t = make_client([judge_script(keys[0])])
+    go(client.create_unary(None, score_params(["a", "b"], inline_model_json(model))))
+    _, _, body = t.requests[0]
+    last = body["messages"][-1]
+    assert last["role"] == "system"
+    assert "Select the response:" in last["content"]
+    assert keys[0] in last["content"] and keys[1] in last["content"]
+    assert "Output exactly one response key" in last["content"]
+    assert "response_format" not in body
+
+
+def test_ballot_appended_to_trailing_system_message():
+    model = make_model([{"model": "judge-a"}])
+    keys = ballot_keys(2)
+    client, t = make_client([judge_script(keys[0])])
+    params = ScoreParams.from_json_obj(
+        {
+            "messages": [
+                {"role": "user", "content": "q"},
+                {"role": "system", "content": "be fair"},
+            ],
+            "model": inline_model_json(model),
+            "choices": ["a", "b"],
+        }
+    )
+    go(client.create_unary(None, params))
+    _, _, body = t.requests[0]
+    assert len(body["messages"]) == 2
+    assert body["messages"][-1]["content"].startswith("be fair\n\n")
+
+
+def test_json_schema_mode_forces_response_format():
+    model = make_model(
+        [{"model": "judge-a", "output_mode": "json_schema"}]
+    )
+    keys = ballot_keys(2)
+    # model outputs JSON containing the key
+    script = Script(
+        [chunk_obj('{"response_key": "%s"}' % keys[1], finish="stop")]
+    )
+    client, t = make_client([script])
+    result = go(
+        client.create_unary(None, score_params(["a", "b"], inline_model_json(model)))
+    )
+    _, _, body = t.requests[0]
+    rf = body["response_format"]
+    assert rf["type"] == "json_schema"
+    assert rf["json_schema"]["schema"]["properties"]["response_key"]["enum"]
+    assert "Output exactly one" not in body["messages"][-1]["content"]
+    cand = {c.index: c for c in result.choices if c.index < 2}
+    assert cand[1].confidence == Decimal(1)
+
+
+def test_tool_call_mode_forces_function_and_folds_args():
+    model = make_model([{"model": "judge-a", "output_mode": "tool_call"}])
+    keys = ballot_keys(2)
+    tool_delta_chunk = {
+        "id": "cc-1",
+        "object": "chat.completion.chunk",
+        "created": 1,
+        "model": "up",
+        "choices": [
+            {
+                "index": 0,
+                "delta": {
+                    "role": "assistant",
+                    "tool_calls": [
+                        {
+                            "index": 0,
+                            "id": "call-1",
+                            "type": "function",
+                            "function": {
+                                "name": "response_key",
+                                "arguments": '{"response_key": "%s"}' % keys[0],
+                            },
+                        }
+                    ],
+                },
+                "finish_reason": None,
+            }
+        ],
+    }
+    done = chunk_obj(finish="tool_calls")
+    client, t = make_client([Script([tool_delta_chunk, done])])
+    result = go(
+        client.create_unary(None, score_params(["a", "b"], inline_model_json(model)))
+    )
+    _, _, body = t.requests[0]
+    assert body["tool_choice"]["function"]["name"] == "response_key"
+    assert body["tools"][0]["function"]["name"] == "response_key"
+    cand = {c.index: c for c in result.choices if c.index < 2}
+    assert cand[0].confidence == Decimal(1)
+    # tool args folded into content; finish_reason tool_calls -> stop
+    judge = [c for c in result.choices if c.index >= 2][0]
+    assert judge.finish_reason == "stop"
+
+
+def test_synthetic_reasoning_adds_think_field():
+    model = make_model(
+        [
+            {
+                "model": "judge-a",
+                "output_mode": "json_schema",
+                "synthetic_reasoning": True,
+            }
+        ]
+    )
+    keys = ballot_keys(2)
+    script = Script(
+        [
+            chunk_obj(
+                '{"_think": "hmm", "response_key": "%s"}' % keys[0],
+                finish="stop",
+            )
+        ]
+    )
+    client, t = make_client([script])
+    go(client.create_unary(None, score_params(["a", "b"], inline_model_json(model))))
+    _, _, body = t.requests[0]
+    schema = body["response_format"]["json_schema"]["schema"]
+    assert schema["required"] == ["_think", "response_key"]
+
+
+def test_judge_sampling_params_forwarded():
+    model = make_model(
+        [
+            {
+                "model": "judge-a",
+                "temperature": 0.2,
+                "top_p": 0.9,
+                "top_logprobs": 5,
+                "max_tokens": 64,
+            }
+        ]
+    )
+    keys = ballot_keys(2, top_logprobs=5)
+    client, t = make_client([judge_script(keys[0])])
+    go(client.create_unary(None, score_params(["a", "b"], inline_model_json(model))))
+    _, _, body = t.requests[0]
+    assert body["temperature"] == 0.2
+    assert body["top_p"] == 0.9
+    assert body["logprobs"] is True
+    assert body["top_logprobs"] == 5
+    assert body["max_tokens"] == 64
+    assert body["model"] == "judge-a"
+
+
+# -- soft votes ---------------------------------------------------------------
+
+
+def test_soft_vote_logprob_distribution_in_tally():
+    model = make_model(
+        [{"model": "judge-a", "top_logprobs": 2, "weight": {"type": "static", "weight": 1}}]
+    )
+    keys = ballot_keys(2, top_logprobs=2)
+    key0 = keys[0]
+    letter0 = key0[1]
+    # sibling letters at the leaf branch
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, 2, 2)
+    pairs = tree.key_indices(rng)
+    branch = tree.walk(key0)
+    letters = list(branch)
+    lp = {
+        "content": [
+            {"token": "`", "logprob": -0.01, "top_logprobs": []},
+            {
+                "token": letter0,
+                "logprob": math.log(0.7),
+                "top_logprobs": [
+                    {"token": letters[0], "logprob": math.log(0.7)},
+                    {"token": letters[1], "logprob": math.log(0.3)},
+                ],
+            },
+            {"token": "`", "logprob": -0.01, "top_logprobs": []},
+        ]
+    }
+    script = Script([chunk_obj(key0, finish="stop", logprobs=lp)])
+    client, _ = make_client([script])
+    result = go(
+        client.create_unary(None, score_params(["a", "b"], inline_model_json(model)))
+    )
+    cand = {c.index: c for c in result.choices if c.index < 2}
+    i0, i1 = branch[letters[0]], branch[letters[1]]
+    assert float(cand[i0].confidence) == pytest.approx(0.7, rel=1e-12)
+    assert float(cand[i1].confidence) == pytest.approx(0.3, rel=1e-12)
+    # soft vote lives in the judge's unary message
+    judge = [c for c in result.choices if c.index >= 2][0]
+    assert judge.message.vote is not None
+    assert float(sum(judge.message.vote)) == pytest.approx(1.0)
+
+
+# -- archived candidates ------------------------------------------------------
+
+
+def test_archived_chat_choice_as_candidate():
+    from llm_weighted_consensus_tpu.types.chat_response import (
+        ChatCompletion as ChatUnary,
+    )
+
+    store = archive.InMemoryArchive()
+    store.put_chat(
+        ChatUnary.from_json_obj(
+            {
+                "id": "cc-old",
+                "object": "chat.completion",
+                "created": 123,
+                "model": "old-model",
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {
+                            "role": "assistant",
+                            "content": "archived alpha",
+                            "refusal": None,
+                            "reasoning": "thought hard",
+                        },
+                        "finish_reason": "stop",
+                    }
+                ],
+            }
+        )
+    )
+    model = make_model([{"model": "judge-a"}])
+    keys = ballot_keys(2)
+    client, t = make_client([judge_script(keys[0])], store=store)
+    params = ScoreParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "q"}],
+            "model": inline_model_json(model),
+            "choices": [
+                {"type": "chat_completion", "id": "cc-old", "choice_index": 0},
+                "plain text candidate",
+            ],
+        }
+    )
+    items = go(collect(client, params))
+    first = items[0]
+    # archived candidate rehydrated with provenance metadata
+    assert first.choices[0].delta.content == "archived alpha"
+    assert first.choices[0].completion_metadata.id == "cc-old"
+    assert first.choices[0].completion_metadata.model == "old-model"
+    # ballot text = reasoning + content joined by blank line
+    _, _, body = t.requests[0]
+    # candidate text inside the ballot JSON map (escaped by serialization)
+    assert "thought hard\\n\\narchived alpha" in body["messages"][-1]["content"]
+
+
+def test_render_tool_calls_in_ballot_text():
+    from llm_weighted_consensus_tpu.clients.score import render_message_text
+    from llm_weighted_consensus_tpu.types.chat_response import Message
+
+    msg = Message.from_json_obj(
+        {
+            "role": "assistant",
+            "content": "calling tools",
+            "refusal": None,
+            "tool_calls": [
+                {
+                    "id": "t1",
+                    "type": "function",
+                    "function": {"name": "search", "arguments": '{"q": "x"}'},
+                }
+            ],
+        }
+    )
+    text = render_message_text(msg)
+    assert text.startswith("calling tools\n\n")
+    assert '"type": "tool_call"' in text
+    assert '"name": "search"' in text
+    assert '"q": "x"' in text
+
+
+# -- trained weights evidence -------------------------------------------------
+
+
+def test_training_table_weight_data_echo_and_usage_seed():
+    from llm_weighted_consensus_tpu.types.embeddings import (
+        CreateEmbeddingResponse,
+    )
+    from llm_weighted_consensus_tpu.weights import (
+        TrainingTableWeightFetcher,
+        WeightFetchers,
+    )
+
+    class FakeTT(TrainingTableWeightFetcher):
+        async def fetch(self, ctx, request, model):
+            resp = CreateEmbeddingResponse.from_json_obj(
+                {
+                    "object": "list",
+                    "data": [{"object": "embedding", "index": 0, "embedding": [0.1, 0.2]}],
+                    "model": "bge-small",
+                    "usage": {"prompt_tokens": 4, "completion_tokens": 0, "total_tokens": 4},
+                }
+            )
+            return [Decimal(3)], TrainingTableData(embeddings_response=resp)
+
+    keys = ballot_keys(2)
+    client, _ = make_client([judge_script(keys[1])])
+    client.weight_fetchers = WeightFetchers(training_table_fetcher=FakeTT())
+    params = ScoreParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "q"}],
+            "model": {
+                "llms": [{"model": "judge-a", "weight": {"type": "training_table"}}],
+                "weight": {
+                    "type": "training_table",
+                    "embeddings": {"model": "bge-small"},
+                    "top": 5,
+                },
+            },
+            "choices": ["a", "b"],
+        }
+    )
+    result = go(client.create_unary(None, params))
+    assert isinstance(result.weight_data, TrainingTableData)
+    assert result.weight_data.embeddings_response.model == "bge-small"
+    # embeddings usage seeds the total (client.rs:330-337)
+    assert result.usage.total_tokens == 4
+    cand = {c.index: c for c in result.choices if c.index < 2}
+    assert cand[1].weight == Decimal(3)

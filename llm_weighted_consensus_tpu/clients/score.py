@@ -310,39 +310,50 @@ def _message_to_delta(message) -> Delta:
 async def merge_streams(streams: list) -> AsyncIterator:
     """Unordered interleaved merge of async iterators (futures select_all,
     client.rs:342-356).  Items surface in arrival order across all judges."""
-    # bounded queue preserves select_all's pull-based backpressure: a slow
+    # Bounded queue preserves select_all's pull-based backpressure: a slow
     # downstream consumer throttles upstream judge reads instead of
-    # buffering every provider token in memory
+    # buffering every provider token in memory.  Completion is tracked via
+    # the pump tasks themselves (not queue sentinels) so an abandoned
+    # consumer can always cancel pumps blocked on a full queue.
     queue: asyncio.Queue = asyncio.Queue(maxsize=16)
-    done = object()
-    crashed = object()
 
     async def pump(stream):
-        try:
-            async for item in stream:
-                await queue.put(item)
-        except asyncio.CancelledError:
-            raise
-        except BaseException as e:
-            await queue.put((crashed, e))
-        finally:
-            await queue.put(done)
+        async for item in stream:
+            await queue.put(item)
 
     tasks = [asyncio.create_task(pump(s)) for s in streams]
-    remaining = len(tasks)
+    getter = None
     try:
-        while remaining:
-            item = await queue.get()
-            if item is done:
-                remaining -= 1
+        while True:
+            # propagate pump crashes (judge streams themselves never raise;
+            # this catches programming errors instead of hanging)
+            for t in tasks:
+                if t.done() and not t.cancelled() and t.exception() is not None:
+                    raise t.exception()
+            while not queue.empty():
+                yield queue.get_nowait()
+            if all(t.done() for t in tasks):
+                if queue.empty():
+                    break
                 continue
-            if isinstance(item, tuple) and len(item) == 2 and item[0] is crashed:
-                raise item[1]
-            yield item
+            if getter is None:
+                getter = asyncio.create_task(queue.get())
+            await asyncio.wait(
+                {getter, *(t for t in tasks if not t.done())},
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if getter.done():
+                item = getter.result()
+                getter = None
+                yield item
     finally:
+        cleanup = list(tasks)
+        if getter is not None:
+            getter.cancel()
+            cleanup.append(getter)
         for t in tasks:
             t.cancel()
-        await asyncio.gather(*tasks, return_exceptions=True)
+        await asyncio.gather(*cleanup, return_exceptions=True)
 
 
 # ---------------------------------------------------------------------------

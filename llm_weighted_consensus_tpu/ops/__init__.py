@@ -1,0 +1,20 @@
+"""Device core: JAX/Pallas consensus kernels.
+
+The numeric heart of the framework, TPU-first (SURVEY §2.8, §3.5):
+
+* ``consensus`` — weighted tally + confidence: ``votes[M,N] x weights[M]``
+  einsum + normalize, replacing the reference's host-side Decimal loop
+  (score client.rs:384-456) for batched/device paths;
+* ``votes``     — batched logprob->probability soft votes (the numeric tail
+  of get_vote, client.rs:1764-1792);
+* ``similarity``— embedding cosine math: pairwise cosine, top-k lookup,
+  softmax cosine consensus vote (the self-consistency scorer);
+* ``kernels``   — fused Pallas TPU kernels for the hot compositions.
+
+Everything is jittable, static-shaped, bf16-friendly with f32 accumulation.
+The streaming serve path keeps exact Decimal math on host (parity with the
+reference); these kernels power archive batch re-scoring, trained weights,
+and the multichat incremental consensus where throughput dominates.
+"""
+
+from . import consensus, kernels, similarity, votes  # noqa: F401

@@ -1,0 +1,125 @@
+"""Fused Pallas TPU kernels for the consensus hot path.
+
+Two fusions that matter for serving latency (keeping intermediates in VMEM
+instead of round-tripping HBM between XLA ops):
+
+* ``fused_consensus``    — weights x votes matmul + normalize in one pass;
+* ``fused_cosine_vote``  — l2-normalize + pairwise cosine + mean-off-diag +
+  masked softmax in one pass (the whole self-consistency scorer).
+
+On non-TPU backends the kernels run in interpret mode (same code path, same
+results) so the CPU test mesh exercises them; beyond the single-block VMEM
+budget the jnp compositions in ``consensus``/``similarity`` are the
+fallback.  Guide: /opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# VMEM is ~16 MB/core; cap single-block shapes well under it
+MAX_FUSED_CHOICES = 1024
+MAX_FUSED_DIM = 2048
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    size = x.shape[axis]
+    target = -(-size // multiple) * multiple
+    if target == size:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - size)
+    return jnp.pad(x, pad)
+
+
+# ---------------------------------------------------------------------------
+# Fused tally + normalize
+# ---------------------------------------------------------------------------
+
+
+def _consensus_kernel(weights_ref, votes_ref, out_ref):
+    # [1, M] x [M, N] on the MXU, then VPU normalize — one VMEM residency
+    cw = jnp.dot(
+        weights_ref[:], votes_ref[:], preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
+    )  # [1, N]
+    total = jnp.sum(cw)
+    out_ref[:] = jnp.where(total > 0, cw / total, 0.0)
+
+
+@jax.jit
+def fused_consensus(votes: jax.Array, weights: jax.Array) -> jax.Array:
+    """votes[M, N], weights[M] -> confidence[N] in a single fused kernel.
+
+    Padding rows/cols are zero so they contribute nothing to the tally.
+    """
+    m, n = votes.shape
+    votes_p = _pad_to(_pad_to(votes.astype(jnp.float32), 0, 8), 1, 128)
+    weights_p = _pad_to(weights.astype(jnp.float32)[None, :], 1, 8)
+    mp, np_ = votes_p.shape
+    out = pl.pallas_call(
+        _consensus_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, np_), jnp.float32),
+        interpret=_interpret(),
+    )(weights_p, votes_p)
+    return out[0, :n]
+
+
+# ---------------------------------------------------------------------------
+# Fused cosine self-consistency vote
+# ---------------------------------------------------------------------------
+
+
+def _cosine_vote_kernel(x_ref, out_ref, *, n_valid: int, temperature: float):
+    x = x_ref[:].astype(jnp.float32)  # [Np, Dp], padding rows are zero
+    norm = jax.lax.rsqrt(
+        jnp.sum(x * x, axis=-1, keepdims=True) + 1e-12
+    )
+    nx = x * norm
+    sims = jnp.dot(nx, nx.T, preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)  # [Np, Np]
+    np_ = sims.shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, (np_, np_), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (np_, np_), 1)
+    valid = (col < n_valid) & (row != col)
+    mean_sim = jnp.sum(jnp.where(valid, sims, 0.0), axis=-1) / max(
+        n_valid - 1, 1
+    )  # [Np]
+    logits = mean_sim / temperature
+    row_valid = jax.lax.iota(jnp.int32, np_) < n_valid
+    logits = jnp.where(row_valid, logits, -jnp.inf)
+    # masked softmax over the valid candidates
+    mx = jnp.max(logits)
+    e = jnp.where(row_valid, jnp.exp(logits - mx), 0.0)
+    out_ref[:] = (e / jnp.sum(e))[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("temperature",))
+def fused_cosine_vote(
+    embeddings: jax.Array, temperature: float = 0.05
+) -> jax.Array:
+    """embeddings[N, D] -> confidence[N]: the whole self-consistency scorer
+    (normalize + cosine + mean + softmax) fused into one kernel."""
+    n, d = embeddings.shape
+    if n > MAX_FUSED_CHOICES or d > MAX_FUSED_DIM:
+        from .similarity import cosine_consensus_vote
+
+        return cosine_consensus_vote(embeddings, temperature=temperature)
+    x = _pad_to(_pad_to(embeddings.astype(jnp.float32), 0, 8), 1, 128)
+    np_ = x.shape[0]
+    out = pl.pallas_call(
+        functools.partial(
+            _cosine_vote_kernel, n_valid=n, temperature=temperature
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, np_), jnp.float32),
+        interpret=_interpret(),
+    )(x)
+    return out[0, :n]

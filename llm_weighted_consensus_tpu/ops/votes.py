@@ -1,0 +1,53 @@
+"""Batched logprob -> probability soft votes on device.
+
+Device twin of the numeric tail of ``ballot.vote.extract_vote``
+(reference get_vote, client.rs:1764-1792): map each ``top_logprobs``
+alternative of the final key token to its candidate leaf, ``exp`` the
+logprobs, and normalize to a distribution over candidates.
+
+The host path handles one judge at a time with exact Decimal math; this
+path handles a whole batch of judges (archive re-scoring, multichat) as one
+fused exp/scatter/normalize.  Scatter is expressed as a one-hot matmul so
+it lands on the MXU instead of serializing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_choices",))
+def softmax_votes(
+    logprobs: jax.Array,
+    candidate_ids: jax.Array,
+    valid: jax.Array,
+    n_choices: int,
+) -> jax.Array:
+    """logprobs[M, K], candidate_ids[M, K] (int, -1 for invalid),
+    valid[M, K] -> votes[M, n_choices], rows normalized (zero if no valid
+    alternative).
+
+    K is the ``top_logprobs`` fan (<= 20).  Invalid slots (letter not a
+    sibling leaf, missing logprob) carry ``valid=0``.
+    """
+    logprobs = logprobs.astype(jnp.float32)
+    valid = valid.astype(jnp.float32)
+    p = jnp.exp(logprobs) * valid  # [M, K]
+    # scatter-add via one-hot contraction (MXU-friendly, no dynamic shapes)
+    onehot = jax.nn.one_hot(candidate_ids, n_choices, dtype=jnp.float32)
+    votes = jnp.einsum("mk,mkn->mn", p, onehot, preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
+    total = jnp.sum(votes, axis=-1, keepdims=True)
+    return jnp.where(total > 0, votes / total, 0.0)
+
+
+@partial(jax.jit, static_argnames=("n_choices",))
+def one_hot_votes(selected: jax.Array, n_choices: int) -> jax.Array:
+    """selected[M] (int, -1 = failed judge) -> votes[M, n_choices].
+
+    The hard-vote fallback (client.rs:1796-1798) batched: failed judges get
+    all-zero rows (their mask handles renormalization in the tally).
+    """
+    return jax.nn.one_hot(selected, n_choices, dtype=jnp.float32)

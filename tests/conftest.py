@@ -7,9 +7,19 @@ initializes its backends, hence here, before any test module imports jax.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points JAX at real hardware
+# (e.g. JAX_PLATFORMS=axon, the single-chip TPU tunnel): tests exercise the
+# virtual 8-device mesh; bench.py is what runs on the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment may pre-import jax pointed at real hardware (sitecustomize
+# in PYTHONPATH); the config update below wins as long as no computation has
+# run yet, which holds at conftest time.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

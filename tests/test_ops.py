@@ -1,0 +1,272 @@
+"""Device kernels vs host math: numeric parity (SURVEY §4: device math is
+f32; votes sum to 1 +- 1e-6; confidence invariants)."""
+
+import math
+import random
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from llm_weighted_consensus_tpu.ops import consensus, kernels, similarity, votes
+
+
+def rand_votes(m, n, seed=0, fail=()):
+    """Random stochastic vote rows; listed judges failed (zero rows)."""
+    rng = np.random.default_rng(seed)
+    v = rng.random((m, n))
+    v = v / v.sum(axis=1, keepdims=True)
+    for i in fail:
+        v[i] = 0.0
+    return v.astype(np.float32)
+
+
+def host_tally(votes_np, weights_np):
+    """The engine's exact-Decimal tally (score client.rs:384-456)."""
+    m, n = votes_np.shape
+    cw = [Decimal(0)] * n
+    for i in range(m):
+        w = Decimal(str(float(weights_np[i])))
+        for j in range(n):
+            cw[j] += Decimal(str(float(votes_np[i, j]))) * w
+    total = sum(cw)
+    conf = [c / total if total > 0 else Decimal(0) for c in cw]
+    return cw, conf
+
+
+# -- tally --------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("m,n", [(2, 2), (8, 64), (128, 3)])
+def test_tally_matches_host_decimal(m, n):
+    v = rand_votes(m, n, seed=m * n)
+    w = np.linspace(0.5, 3.0, m).astype(np.float32)
+    cw, conf = consensus.tally(jnp.asarray(v), jnp.asarray(w))
+    host_cw, host_conf = host_tally(v, w)
+    np.testing.assert_allclose(np.asarray(cw), [float(x) for x in host_cw], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(conf), [float(x) for x in host_conf], atol=1e-6)
+    assert float(jnp.sum(conf)) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_tally_vote_mask_renormalizes():
+    v = rand_votes(4, 3, seed=1)
+    w = np.ones(4, dtype=np.float32)
+    mask = np.array([1, 0, 1, 0], dtype=np.float32)
+    _, conf = consensus.tally(jnp.asarray(v), jnp.asarray(w), jnp.asarray(mask))
+    _, conf_ref = consensus.tally(jnp.asarray(v[[0, 2]]), jnp.asarray(w[[0, 2]]))
+    np.testing.assert_allclose(np.asarray(conf), np.asarray(conf_ref), atol=1e-6)
+
+
+def test_tally_all_failed_is_zero_not_nan():
+    v = np.zeros((3, 4), dtype=np.float32)
+    w = np.ones(3, dtype=np.float32)
+    cw, conf = consensus.tally(jnp.asarray(v), jnp.asarray(w))
+    assert not np.any(np.isnan(np.asarray(conf)))
+    np.testing.assert_array_equal(np.asarray(conf), 0.0)
+    assert bool(consensus.all_failed(jnp.zeros(3)))
+    assert not bool(consensus.all_failed(jnp.array([0.0, 1.0])))
+
+
+def test_judge_confidence():
+    v = rand_votes(3, 4, seed=2)
+    w = np.array([2.0, 1.0, 1.0], dtype=np.float32)
+    _, conf = consensus.tally(jnp.asarray(v), jnp.asarray(w))
+    jc = consensus.judge_confidence(jnp.asarray(v), conf)
+    expected = v @ np.asarray(conf)
+    np.testing.assert_allclose(np.asarray(jc), expected, atol=1e-6)
+
+
+def test_tally_batch_vmap():
+    b, m, n = 5, 4, 3
+    v = np.stack([rand_votes(m, n, seed=i) for i in range(b)])
+    w = np.ones((b, m), dtype=np.float32)
+    mask = np.ones((b, m), dtype=np.float32)
+    cw, conf = consensus.tally_batch(
+        jnp.asarray(v), jnp.asarray(w), jnp.asarray(mask)
+    )
+    assert cw.shape == (b, n) and conf.shape == (b, n)
+    # mask defaults to all-ones
+    _, conf_nomask = consensus.tally_batch(jnp.asarray(v), jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(conf_nomask), np.asarray(conf), atol=1e-6)
+    for i in range(b):
+        _, single = consensus.tally(jnp.asarray(v[i]), jnp.asarray(w[i]))
+        np.testing.assert_allclose(np.asarray(conf[i]), np.asarray(single), atol=1e-6)
+
+
+def test_incremental_tally_matches_full():
+    m, n = 6, 4
+    v = rand_votes(m, n, seed=3)
+    w = np.linspace(1, 2, m).astype(np.float32)
+    running = jnp.zeros(n, dtype=jnp.float32)
+    for i in range(m):
+        running, conf = consensus.incremental_tally(
+            running, jnp.asarray(v[i]), float(w[i])
+        )
+    _, full = consensus.tally(jnp.asarray(v), jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(conf), np.asarray(full), atol=1e-6)
+
+
+# -- soft votes ---------------------------------------------------------------
+
+
+def test_softmax_votes_matches_ballot_extractor():
+    """Device batch path == host Decimal path on the same logprob data."""
+    from dataclasses import dataclass, field as dfield
+
+    from llm_weighted_consensus_tpu.ballot import PrefixTree, extract_vote
+
+    @dataclass
+    class Top:
+        token: str
+        logprob: float = None
+
+    @dataclass
+    class Tok:
+        token: str
+        logprob: float = None
+        top_logprobs: list = dfield(default_factory=list)
+
+    n = 5
+    rng = random.Random(9)
+    tree = PrefixTree.build(rng, n, 20)
+    pairs = tree.key_indices(rng)
+    wt, wo = PrefixTree.regex_patterns([k for k, _ in pairs])
+    key, _ = pairs[0]
+    branch = tree.walk(key)
+    letters = list(branch)[:4]
+    lps = [math.log(p) for p in (0.4, 0.3, 0.2, 0.1)]
+    top = [Top(c, lp) for c, lp in zip(letters, lps)]
+    toks = [Tok("`"), Tok(key[1], top_logprobs=top), Tok("`")]
+    host = extract_vote(tree, wt, wo, n, key, toks)
+
+    ids = np.array([[branch[c] for c in letters]])
+    device = votes.softmax_votes(
+        jnp.asarray([lps]), jnp.asarray(ids), jnp.ones((1, 4)), n
+    )
+    np.testing.assert_allclose(
+        np.asarray(device)[0], [float(x) for x in host], atol=1e-6
+    )
+
+
+def test_softmax_votes_invalid_slots_and_empty_rows():
+    lp = np.log(np.array([[0.5, 0.5, 0.1], [0.9, 0.1, 0.1]], dtype=np.float32))
+    ids = np.array([[0, 1, -1], [2, 0, 1]])
+    valid = np.array([[1, 1, 0], [0, 0, 0]], dtype=np.float32)
+    v = votes.softmax_votes(jnp.asarray(lp), jnp.asarray(ids), jnp.asarray(valid), 3)
+    np.testing.assert_allclose(np.asarray(v[0]), [0.5, 0.5, 0.0], atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(v[1]), 0.0)  # failed row
+
+
+def test_one_hot_votes():
+    v = votes.one_hot_votes(jnp.asarray([2, -1, 0]), 3)
+    np.testing.assert_array_equal(
+        np.asarray(v), [[0, 0, 1], [0, 0, 0], [1, 0, 0]]
+    )
+
+
+# -- similarity ---------------------------------------------------------------
+
+
+def test_pairwise_cosine_vs_numpy():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(6, 32)).astype(np.float32)
+    s = np.asarray(similarity.pairwise_cosine(jnp.asarray(x)))
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    np.testing.assert_allclose(s, xn @ xn.T, atol=1e-5)
+    np.testing.assert_allclose(np.diag(s), 1.0, atol=1e-5)
+
+
+def test_cosine_consensus_vote_prefers_cluster():
+    rng = np.random.default_rng(5)
+    base = rng.normal(size=32).astype(np.float32)
+    cluster = np.stack([base + 0.01 * rng.normal(size=32) for _ in range(4)])
+    outlier = -base[None, :]
+    emb = np.concatenate([cluster, outlier]).astype(np.float32)
+    conf = np.asarray(similarity.cosine_consensus_vote(jnp.asarray(emb)))
+    assert conf.shape == (5,)
+    assert conf.sum() == pytest.approx(1.0, abs=1e-5)
+    assert conf[:4].min() > conf[4] * 10  # outlier crushed
+
+
+def test_top_k_similar():
+    table = np.eye(4, 8, dtype=np.float32)
+    q = np.eye(4, 8, dtype=np.float32)[1:2]
+    scores, idx = similarity.top_k_similar(jnp.asarray(table), jnp.asarray(q), 2)
+    assert int(idx[0, 0]) == 1
+    assert float(scores[0, 0]) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_training_table_weights_bounds_and_direction():
+    rng = np.random.default_rng(6)
+    table = rng.normal(size=(16, 8)).astype(np.float32)
+    # judge 0 always right (score 1), judge 1 always wrong (score 0)
+    scores = np.stack([np.ones(16), np.zeros(16)]).astype(np.float32)
+    q = table[3:4]  # exact match of row 3
+    w = np.asarray(
+        similarity.training_table_weights(
+            jnp.asarray(table),
+            jnp.asarray(scores),
+            jnp.asarray(q),
+            jnp.asarray([1.0, 1.0]),
+            jnp.asarray([4.0, 4.0]),
+            4,
+        )
+    )
+    assert w.shape == (1, 2)
+    assert 1.0 <= w[0, 1] < w[0, 0] <= 4.0
+    assert w[0, 0] == pytest.approx(4.0, abs=0.2)  # strong judge near max
+
+
+# -- fused pallas kernels -----------------------------------------------------
+
+
+@pytest.mark.parametrize("m,n", [(3, 5), (8, 128), (17, 200)])
+def test_fused_consensus_matches_jnp(m, n):
+    v = rand_votes(m, n, seed=m + n)
+    w = np.linspace(0.5, 2.0, m).astype(np.float32)
+    fused = np.asarray(kernels.fused_consensus(jnp.asarray(v), jnp.asarray(w)))
+    _, ref = consensus.tally(jnp.asarray(v), jnp.asarray(w))
+    np.testing.assert_allclose(fused, np.asarray(ref), atol=1e-6)
+
+
+def test_fused_consensus_all_zero():
+    v = np.zeros((4, 6), dtype=np.float32)
+    w = np.ones(4, dtype=np.float32)
+    fused = np.asarray(kernels.fused_consensus(jnp.asarray(v), jnp.asarray(w)))
+    assert not np.any(np.isnan(fused))
+
+
+@pytest.mark.parametrize("n,d", [(4, 32), (5, 100), (16, 384)])
+def test_fused_cosine_vote_matches_jnp(n, d):
+    rng = np.random.default_rng(n * d)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    fused = np.asarray(kernels.fused_cosine_vote(jnp.asarray(x)))
+    ref = np.asarray(similarity.cosine_consensus_vote(jnp.asarray(x)))
+    np.testing.assert_allclose(fused, ref, atol=1e-5)
+    assert fused.sum() == pytest.approx(1.0, abs=1e-5)
+
+
+# -- sharded execution on the CPU mesh ----------------------------------------
+
+
+def test_tally_batch_sharded_over_mesh():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devices, ("dp",))
+    b, m, n = 16, 8, 4
+    v = np.stack([rand_votes(m, n, seed=i) for i in range(b)])
+    w = np.ones((b, m), dtype=np.float32)
+    mask = np.ones((b, m), dtype=np.float32)
+    sharding = NamedSharding(mesh, P("dp"))
+    vs = jax.device_put(jnp.asarray(v), sharding)
+    ws = jax.device_put(jnp.asarray(w), sharding)
+    ms = jax.device_put(jnp.asarray(mask), sharding)
+    cw, conf = consensus.tally_batch(vs, ws, ms)
+    assert conf.shape == (b, n)
+    for i in range(b):
+        _, single = consensus.tally(jnp.asarray(v[i]), jnp.asarray(w[i]))
+        np.testing.assert_allclose(np.asarray(conf[i]), np.asarray(single), atol=1e-6)

@@ -300,6 +300,44 @@ def test_invalid_ballot_content_is_invalid_content_error():
     assert errs and errs[0].code == 500
 
 
+def test_merge_streams_abandoned_consumer_no_deadlock():
+    # regression: pumps blocked on a full queue must be cancellable when the
+    # consumer abandons the stream (client disconnect)
+    from llm_weighted_consensus_tpu.clients.score import merge_streams
+
+    async def noisy(n=500):
+        for i in range(n):
+            yield i
+
+    async def main():
+        gen = merge_streams([noisy(), noisy()])
+        async for _ in gen:
+            break  # abandon with producers still pushing
+        await asyncio.wait_for(gen.aclose(), timeout=2)
+
+    go(main())
+
+
+def test_merge_streams_propagates_pump_crash():
+    from llm_weighted_consensus_tpu.clients.score import merge_streams
+
+    async def ok():
+        yield 1
+
+    async def boom():
+        yield 2
+        raise RuntimeError("pump crash")
+
+    async def main():
+        items = []
+        with pytest.raises(RuntimeError, match="pump crash"):
+            async for item in merge_streams([ok(), boom()]):
+                items.append(item)
+        assert set(items) <= {1, 2}
+
+    go(main())
+
+
 # -- request validation -------------------------------------------------------
 
 

@@ -325,13 +325,14 @@ async def merge_streams(streams: list) -> AsyncIterator:
     getter = None
     try:
         while True:
+            # drain already-arrived items from healthy judges FIRST, then
             # propagate pump crashes (judge streams themselves never raise;
             # this catches programming errors instead of hanging)
+            while not queue.empty():
+                yield queue.get_nowait()
             for t in tasks:
                 if t.done() and not t.cancelled() and t.exception() is not None:
                     raise t.exception()
-            while not queue.empty():
-                yield queue.get_nowait()
             if all(t.done() for t in tasks):
                 if queue.empty():
                     break

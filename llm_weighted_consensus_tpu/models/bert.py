@@ -1,0 +1,273 @@
+"""Functional JAX BERT encoder (BGE-class).
+
+TPU-first design decisions (SURVEY §2.8 note: pure DP suffices for
+bge-small/large — no TP needed; the batch axis is the sharded axis):
+
+* params are a plain nested-dict pytree — shard/checkpoint/donate freely;
+* all matmuls run in the param dtype (bf16 on TPU) with f32 accumulation on
+  the MXU (``preferred_element_type``); layernorm and softmax always f32;
+* static shapes only: (batch, seq) fixed per jit specialization, attention
+  mask handles padding — no data-dependent control flow;
+* one fused forward: embeddings -> N transformer layers (lax.scan over
+  stacked layer params so XLA compiles ONE layer body regardless of depth)
+  -> pooled embedding.
+
+Weight layout matches HuggingFace BERT so real bge checkpoints load via
+``from_hf_weights`` when available offline; random init otherwise.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .configs import BertConfig
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(rng, in_dim, out_dim, dtype):
+    w_rng, _ = jax.random.split(rng)
+    scale = 0.02
+    return {
+        "kernel": (
+            jax.random.normal(w_rng, (in_dim, out_dim), jnp.float32) * scale
+        ).astype(dtype),
+        "bias": jnp.zeros((out_dim,), dtype),
+    }
+
+
+def _ln_init(dim, dtype):
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def init_params(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> dict:
+    """Random-init parameters; layer params are stacked on a leading axis
+    for the scanned layer body."""
+    keys = jax.random.split(rng, 8)
+    h, i = config.hidden_size, config.intermediate_size
+
+    def layer_params(layer_rng):
+        ks = jax.random.split(layer_rng, 6)
+        return {
+            "attn_q": _dense_init(ks[0], h, h, dtype),
+            "attn_k": _dense_init(ks[1], h, h, dtype),
+            "attn_v": _dense_init(ks[2], h, h, dtype),
+            "attn_out": _dense_init(ks[3], h, h, dtype),
+            "attn_ln": _ln_init(h, dtype),
+            "mlp_in": _dense_init(ks[4], h, i, dtype),
+            "mlp_out": _dense_init(ks[5], i, h, dtype),
+            "mlp_ln": _ln_init(h, dtype),
+        }
+
+    layer_keys = jax.random.split(keys[0], config.num_layers)
+    layers = jax.vmap(layer_params)(layer_keys)
+
+    return {
+        "token_embed": (
+            jax.random.normal(
+                keys[1], (config.vocab_size, h), jnp.float32
+            )
+            * 0.02
+        ).astype(dtype),
+        "position_embed": (
+            jax.random.normal(
+                keys[2], (config.max_position_embeddings, h), jnp.float32
+            )
+            * 0.02
+        ).astype(dtype),
+        "type_embed": (
+            jax.random.normal(keys[3], (config.type_vocab_size, h), jnp.float32)
+            * 0.02
+        ).astype(dtype),
+        "embed_ln": _ln_init(h, dtype),
+        "layers": layers,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _layer_norm(x, params, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (
+        normed * params["scale"].astype(jnp.float32)
+        + params["bias"].astype(jnp.float32)
+    ).astype(x.dtype)
+
+
+def _dense(x, p):
+    return (
+        jnp.einsum(
+            "...i,io->...o",
+            x,
+            p["kernel"],
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        + p["bias"]
+    )
+
+
+def _attention(x, p, mask_bias, config: BertConfig):
+    b, s, h = x.shape
+    nh, hd = config.num_heads, config.head_dim
+
+    def heads(t):
+        return t.reshape(b, s, nh, hd)
+
+    q = heads(_dense(x, p["attn_q"]))
+    k = heads(_dense(x, p["attn_k"]))
+    v = heads(_dense(x, p["attn_v"]))
+    # [b, nh, s, s] logits accumulated in f32 on the MXU
+    logits = jnp.einsum(
+        "bqnd,bknd->bnqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(hd))
+    logits = logits + mask_bias  # [b, 1, 1, s] additive -inf padding
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum(
+        "bnqk,bknd->bqnd", probs, v, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    return _dense(ctx.reshape(b, s, h), p["attn_out"])
+
+
+def _layer(x, p, mask_bias, config: BertConfig):
+    attn = _attention(x, p, mask_bias, config)
+    x = _layer_norm(x + attn, p["attn_ln"], config.layer_norm_eps)
+    mlp = _dense(jax.nn.gelu(_dense(x, p["mlp_in"])), p["mlp_out"])
+    return _layer_norm(x + mlp, p["mlp_ln"], config.layer_norm_eps)
+
+
+def encode(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    config: BertConfig,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """input_ids[b, s], attention_mask[b, s] -> hidden[b, s, h]."""
+    b, s = input_ids.shape
+    x = params["token_embed"][input_ids]
+    x = x + params["position_embed"][jnp.arange(s)][None, :, :]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + params["type_embed"][token_type_ids]
+    x = _layer_norm(x, params["embed_ln"], config.layer_norm_eps)
+
+    mask_bias = jnp.where(
+        attention_mask[:, None, None, :] > 0, 0.0, -1e9
+    ).astype(jnp.float32)
+
+    # scan over stacked layers: ONE compiled layer body for any depth
+    def body(carry, layer_p):
+        return _layer(carry, layer_p, mask_bias, config), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def pool(
+    hidden: jax.Array,
+    attention_mask: jax.Array,
+    pooling: str = "cls",
+    normalize: bool = True,
+) -> jax.Array:
+    """hidden[b, s, h] -> embedding[b, h] (bge uses CLS + l2-normalize)."""
+    if pooling == "cls":
+        emb = hidden[:, 0, :]
+    elif pooling == "mean":
+        mask = attention_mask[:, :, None].astype(hidden.dtype)
+        emb = jnp.sum(hidden * mask, axis=1) / jnp.maximum(
+            jnp.sum(mask, axis=1), 1
+        )
+    else:
+        raise ValueError(f"unknown pooling {pooling!r}")
+    emb = emb.astype(jnp.float32)
+    if normalize:
+        norm = jnp.sqrt(jnp.sum(emb * emb, axis=-1, keepdims=True))
+        emb = emb / jnp.maximum(norm, 1e-12)
+    return emb
+
+
+@partial(jax.jit, static_argnames=("config", "pooling", "normalize"))
+def embed(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    config: BertConfig,
+    pooling: str = "cls",
+    normalize: bool = True,
+) -> jax.Array:
+    """The jitted end-to-end embedding forward: ids -> pooled vectors."""
+    hidden = encode(params, input_ids, attention_mask, config)
+    return pool(hidden, attention_mask, pooling, normalize)
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint import (offline)
+# ---------------------------------------------------------------------------
+
+_HF_LAYER_MAP = {
+    "attn_q": "attention.self.query",
+    "attn_k": "attention.self.key",
+    "attn_v": "attention.self.value",
+    "attn_out": "attention.output.dense",
+    "mlp_in": "intermediate.dense",
+    "mlp_out": "output.dense",
+}
+_HF_LN_MAP = {
+    "attn_ln": "attention.output.LayerNorm",
+    "mlp_ln": "output.LayerNorm",
+}
+
+
+def from_hf_weights(state_dict: dict, config: BertConfig, dtype=jnp.float32) -> dict:
+    """Map a HuggingFace BERT state dict (numpy arrays) into our pytree.
+
+    Works with any BERT-architecture checkpoint (bge-*-en-v1.5 included)
+    loaded from local files — no network access is assumed here.
+    """
+
+    def get(name):
+        arr = state_dict[name]
+        return jnp.asarray(arr, dtype=dtype)
+
+    def dense(prefix):
+        return {
+            # torch Linear stores [out, in]; ours is [in, out]
+            "kernel": get(f"{prefix}.weight").T,
+            "bias": get(f"{prefix}.bias"),
+        }
+
+    def ln(prefix):
+        return {"scale": get(f"{prefix}.weight"), "bias": get(f"{prefix}.bias")}
+
+    layers = []
+    for i in range(config.num_layers):
+        base = f"encoder.layer.{i}"
+        layer = {
+            name: dense(f"{base}.{hf}") for name, hf in _HF_LAYER_MAP.items()
+        }
+        layer.update(
+            {name: ln(f"{base}.{hf}") for name, hf in _HF_LN_MAP.items()}
+        )
+        layers.append(layer)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+
+    return {
+        "token_embed": get("embeddings.word_embeddings.weight"),
+        "position_embed": get("embeddings.position_embeddings.weight"),
+        "type_embed": get("embeddings.token_type_embeddings.weight"),
+        "embed_ln": ln("embeddings.LayerNorm"),
+        "layers": stacked,
+    }

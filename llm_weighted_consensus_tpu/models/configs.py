@@ -1,0 +1,80 @@
+"""Encoder configurations (BGE family + DeBERTa-v3 reward model)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 1536
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+# BGE family (BAAI/bge-*-en-v1.5 shapes)
+BGE_SMALL = BertConfig(
+    hidden_size=384, num_layers=12, num_heads=12, intermediate_size=1536
+)
+BGE_BASE = BertConfig(
+    hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072
+)
+BGE_LARGE = BertConfig(
+    hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096
+)
+
+# tiny config for tests: fast init/compile on the CPU mesh
+TEST_TINY = BertConfig(
+    vocab_size=512,
+    hidden_size=64,
+    num_layers=2,
+    num_heads=4,
+    intermediate_size=128,
+    max_position_embeddings=64,
+)
+
+PRESETS = {
+    "bge-small-en": BGE_SMALL,
+    "bge-base-en": BGE_BASE,
+    "bge-large-en": BGE_LARGE,
+    "test-tiny": TEST_TINY,
+}
+
+
+@dataclass(frozen=True)
+class DebertaConfig:
+    """DeBERTa-style encoder with disentangled relative attention."""
+
+    vocab_size: int = 128100
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_relative_positions: int = 128  # relative position bucket span k
+    layer_norm_eps: float = 1e-7
+    pad_token_id: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+DEBERTA_V3_BASE = DebertaConfig()
+DEBERTA_TEST_TINY = DebertaConfig(
+    vocab_size=512,
+    hidden_size=64,
+    num_layers=2,
+    num_heads=4,
+    intermediate_size=128,
+    max_relative_positions=16,
+)

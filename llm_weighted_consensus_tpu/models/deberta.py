@@ -1,0 +1,196 @@
+"""DeBERTa-style encoder + scalar reward head (reward-model re-ranking).
+
+BASELINE config 3 replaces the cosine self-consistency vote with a trained
+reward model (deberta-v3 class).  The architectural difference from BERT is
+**disentangled attention**: no absolute position embeddings; instead every
+layer adds content->position and position->content terms computed against a
+shared relative-position embedding table:
+
+    score(i, j) = q_c[i]·k_c[j] + q_c[i]·k_r[d(i,j)] + k_c[j]·q_r[d(i,j)]
+
+scaled by 1/sqrt(3*head_dim), with d(i, j) the clamped relative distance.
+This keeps shapes static (the relative index matrix is precomputed per seq
+length) and every contraction on the MXU.
+
+The reward head is the standard RM recipe: CLS pooled state -> dense ->
+tanh -> dense(1) -> scalar reward per (prompt, candidate) sequence;
+``reward_consensus_vote`` turns N candidate rewards into a confidence
+distribution, slotting into the same tally as ballot votes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+import jax
+import jax.numpy as jnp
+
+from .configs import DebertaConfig
+
+
+def _dense_init(rng, in_dim, out_dim, dtype):
+    return {
+        "kernel": (
+            jax.random.normal(rng, (in_dim, out_dim), jnp.float32) * 0.02
+        ).astype(dtype),
+        "bias": jnp.zeros((out_dim,), dtype),
+    }
+
+
+def _ln_init(dim, dtype):
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def init_params(rng, config: DebertaConfig, dtype=jnp.float32) -> dict:
+    keys = jax.random.split(rng, 6)
+    h, i, k = config.hidden_size, config.intermediate_size, config.max_relative_positions
+
+    def layer_params(layer_rng):
+        ks = jax.random.split(layer_rng, 8)
+        return {
+            "attn_q": _dense_init(ks[0], h, h, dtype),
+            "attn_k": _dense_init(ks[1], h, h, dtype),
+            "attn_v": _dense_init(ks[2], h, h, dtype),
+            "pos_q": _dense_init(ks[3], h, h, dtype),
+            "pos_k": _dense_init(ks[4], h, h, dtype),
+            "attn_out": _dense_init(ks[5], h, h, dtype),
+            "attn_ln": _ln_init(h, dtype),
+            "mlp_in": _dense_init(ks[6], h, i, dtype),
+            "mlp_out": _dense_init(ks[7], i, h, dtype),
+            "mlp_ln": _ln_init(h, dtype),
+        }
+
+    layers = jax.vmap(layer_params)(
+        jax.random.split(keys[0], config.num_layers)
+    )
+    return {
+        "token_embed": (
+            jax.random.normal(keys[1], (config.vocab_size, h), jnp.float32)
+            * 0.02
+        ).astype(dtype),
+        "embed_ln": _ln_init(h, dtype),
+        # shared relative position table covering [-k, k)
+        "rel_embed": (
+            jax.random.normal(keys[2], (2 * k, h), jnp.float32) * 0.02
+        ).astype(dtype),
+        "rel_ln": _ln_init(h, dtype),
+        "layers": layers,
+        "head_dense": _dense_init(keys[3], h, h, dtype),
+        "head_out": _dense_init(keys[4], h, 1, dtype),
+    }
+
+
+def _layer_norm(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (
+        out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    ).astype(x.dtype)
+
+
+def _dense(x, p):
+    return (
+        jnp.einsum(
+            "...i,io->...o", x, p["kernel"], preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        + p["bias"]
+    )
+
+
+def _rel_index(seq: int, k: int) -> jax.Array:
+    """[seq, seq] bucket indices: clamp(i - j, -k, k-1) + k."""
+    pos = jnp.arange(seq)
+    rel = pos[:, None] - pos[None, :]
+    return jnp.clip(rel, -k, k - 1) + k
+
+
+def _disentangled_attention(x, rel, p, mask_bias, config: DebertaConfig):
+    b, s, h = x.shape
+    nh, hd = config.num_heads, config.head_dim
+    k = config.max_relative_positions
+
+    q_c = _dense(x, p["attn_q"]).reshape(b, s, nh, hd)
+    k_c = _dense(x, p["attn_k"]).reshape(b, s, nh, hd)
+    v = _dense(x, p["attn_v"]).reshape(b, s, nh, hd)
+    # relative projections of the shared table: [2k, nh, hd]
+    q_r = _dense(rel, p["pos_q"]).reshape(2 * k, nh, hd)
+    k_r = _dense(rel, p["pos_k"]).reshape(2 * k, nh, hd)
+
+    rel_idx = _rel_index(s, k)  # [s, s]
+
+    # content -> content
+    c2c = jnp.einsum(
+        "bqnd,bknd->bnqk", q_c, k_c, preferred_element_type=jnp.float32
+    )
+    # content -> position: q_c against every bucket, then gather per (i, j)
+    c2p_all = jnp.einsum(
+        "bqnd,rnd->bnqr", q_c, k_r, preferred_element_type=jnp.float32
+    )  # [b, nh, s, 2k]
+    c2p = jnp.take_along_axis(
+        c2p_all, rel_idx[None, None, :, :], axis=-1
+    )  # [b, nh, s, s]
+    # position -> content: k_c against every bucket, transposed gather
+    p2c_all = jnp.einsum(
+        "bknd,rnd->bnkr", k_c, q_r, preferred_element_type=jnp.float32
+    )  # [b, nh, s, 2k]
+    p2c = jnp.take_along_axis(
+        p2c_all, rel_idx.T[None, None, :, :], axis=-1
+    )  # [b, nh, k_pos=s, q_pos=s] -> transpose to [b, nh, q, k]
+    p2c = jnp.swapaxes(p2c, -1, -2)
+
+    scale = 1.0 / jnp.sqrt(jnp.float32(3 * hd))
+    logits = (c2c + c2p + p2c) * scale + mask_bias
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum(
+        "bnqk,bknd->bqnd", probs, v, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    return _dense(ctx.reshape(b, s, h), p["attn_out"])
+
+
+def encode(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    config: DebertaConfig,
+) -> jax.Array:
+    x = params["token_embed"][input_ids]
+    x = _layer_norm(x, params["embed_ln"], config.layer_norm_eps)
+    rel = _layer_norm(
+        params["rel_embed"], params["rel_ln"], config.layer_norm_eps
+    )
+    mask_bias = jnp.where(
+        attention_mask[:, None, None, :] > 0, 0.0, -1e9
+    ).astype(jnp.float32)
+
+    def body(carry, layer_p):
+        attn = _disentangled_attention(carry, rel, layer_p, mask_bias, config)
+        y = _layer_norm(carry + attn, layer_p["attn_ln"], config.layer_norm_eps)
+        mlp = _dense(jax.nn.gelu(_dense(y, layer_p["mlp_in"])), layer_p["mlp_out"])
+        return _layer_norm(y + mlp, layer_p["mlp_ln"], config.layer_norm_eps), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+@partial(jax.jit, static_argnames=("config",))
+def reward(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    config: DebertaConfig,
+) -> jax.Array:
+    """(prompt ++ candidate) token batch -> scalar reward per row [b]."""
+    hidden = encode(params, input_ids, attention_mask, config)
+    cls = hidden[:, 0, :].astype(jnp.float32)
+    z = jnp.tanh(_dense(cls, params["head_dense"]).astype(jnp.float32))
+    return _dense(z, params["head_out"]).astype(jnp.float32)[:, 0]
+
+
+@partial(jax.jit, static_argnames=("temperature",))
+def reward_consensus_vote(
+    rewards: jax.Array, temperature: float = 1.0
+) -> jax.Array:
+    """rewards[N] -> confidence[N]: RM re-ranking as a consensus vote
+    (drop-in for ops.similarity.cosine_consensus_vote)."""
+    return jax.nn.softmax(rewards.astype(jnp.float32) / temperature)

@@ -1,0 +1,134 @@
+"""Host<->device embedding pipeline exposing the OpenAI wire contract.
+
+The serve-path boundary (SURVEY §3.1 note: "the trained-weight path crosses
+host<->device (PJRT) instead" of HTTP): texts are tokenized on host, padded
+to bucketed static shapes (bounding jit specializations), embedded by the
+jitted BERT forward, and returned both as arrays (device consumers) and as
+``CreateEmbeddingResponse`` JSON (wire consumers + usage accounting that
+seeds ``weight_data`` cost, score client.rs:330-337).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types.chat_response import Usage
+from ..types.embeddings import CreateEmbeddingResponse, Embedding
+from . import bert
+from .configs import PRESETS, BertConfig
+from .tokenizer import BaseTokenizer, load_tokenizer
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Next power of two >= n (min 16), capped."""
+    size = 16
+    while size < n:
+        size *= 2
+    return min(size, cap)
+
+
+class TpuEmbedder:
+    """A BGE-class encoder ready to embed batches on device.
+
+    ``params=None`` random-inits (tests / no local checkpoint); pass a
+    pytree from ``bert.from_hf_weights`` for real bge weights.  ``shard``
+    (set by ``parallel.shard_embedder``) places params and batches on a
+    mesh; single-device otherwise.
+    """
+
+    def __init__(
+        self,
+        model: str = "bge-small-en",
+        *,
+        params: Optional[dict] = None,
+        config: Optional[BertConfig] = None,
+        tokenizer: Optional[BaseTokenizer] = None,
+        dtype=None,
+        max_tokens: int = 512,
+        pooling: str = "cls",
+        seed: int = 0,
+    ) -> None:
+        self.model_name = model
+        self.config = config or PRESETS[model]
+        self.max_tokens = min(max_tokens, self.config.max_position_embeddings)
+        self.pooling = pooling
+        if dtype is None:
+            dtype = (
+                jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            )
+        self.dtype = dtype
+        self.tokenizer = tokenizer or load_tokenizer(
+            vocab_size=self.config.vocab_size
+        )
+        if params is None:
+            params = bert.init_params(
+                jax.random.PRNGKey(seed), self.config, dtype=dtype
+            )
+        self.params = params
+        self.put_batch = lambda ids, mask: (ids, mask)  # mesh hook
+
+    # -- core ----------------------------------------------------------------
+
+    def tokenize(self, texts: Iterable[str], max_tokens: Optional[int] = None):
+        cap = min(max_tokens or self.max_tokens, self.max_tokens)
+        ids, mask = self.tokenizer.encode_batch(list(texts), cap)
+        seq = _bucket(int(mask.sum(axis=1).max(initial=1)), cap)
+        return ids[:, :seq], mask[:, :seq]
+
+    def embed_texts(
+        self, texts: list, max_tokens: Optional[int] = None
+    ) -> np.ndarray:
+        """texts -> embeddings[B, H] (f32, l2-normalized)."""
+        ids, mask = self.tokenize(texts, max_tokens)
+        return self.embed_tokens(ids, mask)
+
+    def embed_tokens(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        b = ids.shape[0]
+        pad_b = _bucket(b, 4096)
+        if pad_b != b:
+            ids = np.pad(ids, ((0, pad_b - b), (0, 0)))
+            mask = np.pad(mask, ((0, pad_b - b), (0, 0)))
+        dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
+        emb = bert.embed(
+            self.params,
+            dev_ids,
+            dev_mask,
+            self.config,
+            pooling=self.pooling,
+            normalize=True,
+        )
+        return np.asarray(emb[:b])
+
+    def token_count(self, texts: list, max_tokens: Optional[int] = None) -> int:
+        _, mask = self.tokenize(texts, max_tokens)
+        return int(mask.sum())
+
+    # -- wire contract --------------------------------------------------------
+
+    def embeddings_response(
+        self, texts: list, max_tokens: Optional[int] = None
+    ) -> CreateEmbeddingResponse:
+        """The OpenAI embeddings response (types/embeddings.py), with usage
+        = real token counts for cost accounting.  Tokenizes once."""
+        ids, mask = self.tokenize(texts, max_tokens)
+        emb = self.embed_tokens(ids, mask)
+        tokens = int(mask.sum())
+        return CreateEmbeddingResponse(
+            object="list",
+            data=[
+                Embedding(
+                    object="embedding",
+                    index=i,
+                    embedding=[float(v) for v in row],
+                )
+                for i, row in enumerate(emb)
+            ],
+            model=self.model_name,
+            usage=Usage(
+                prompt_tokens=tokens, completion_tokens=0, total_tokens=tokens
+            ),
+        )

@@ -1,0 +1,176 @@
+"""Host-side tokenization for the on-TPU encoders.
+
+Tokenization stays on host (SURVEY §7 phase 5: "tokenize host-side"); the
+device sees only int arrays with static shapes.  Two implementations behind
+one interface:
+
+* ``WordPieceTokenizer`` — the real BERT algorithm (basic whitespace +
+  punctuation split, greedy longest-match with ``##`` continuations) given
+  a ``vocab.txt``; loads bge vocabularies from local files (no network);
+* ``HashTokenizer``     — deterministic hashing into a fixed vocab so the
+  whole pipeline (tests, CPU mesh, benches without downloaded assets) runs
+  with identical shapes and padding behavior.
+
+Both pad/truncate to a fixed ``max_length`` and return numpy int32 arrays
+(ids, attention_mask).
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Iterable, Optional
+
+import numpy as np
+
+CLS, SEP, PAD, UNK = "[CLS]", "[SEP]", "[PAD]", "[UNK]"
+
+
+class BaseTokenizer:
+    pad_id: int = 0
+
+    def encode_batch(self, texts: Iterable[str], max_length: int = 512):
+        rows = [self._encode(t, max_length) for t in texts]
+        n = len(rows)
+        ids = np.full((n, max_length), self.pad_id, dtype=np.int32)
+        mask = np.zeros((n, max_length), dtype=np.int32)
+        for i, row in enumerate(rows):
+            ids[i, : len(row)] = row
+            mask[i, : len(row)] = 1
+        return ids, mask
+
+    def _encode(self, text: str, max_length: int):
+        raise NotImplementedError
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (
+        33 <= cp <= 47
+        or 58 <= cp <= 64
+        or 91 <= cp <= 96
+        or 123 <= cp <= 126
+    ):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def basic_tokenize(text: str) -> list:
+    """Lowercase, strip accents, split on whitespace and punctuation."""
+    text = unicodedata.normalize("NFD", text.lower())
+    out = []
+    word = []
+    for ch in text:
+        if unicodedata.category(ch) == "Mn":
+            continue  # strip accents
+        if ch.isspace():
+            if word:
+                out.append("".join(word))
+                word = []
+        elif _is_punctuation(ch):
+            if word:
+                out.append("".join(word))
+                word = []
+            out.append(ch)
+        else:
+            word.append(ch)
+    if word:
+        out.append("".join(word))
+    return out
+
+
+class WordPieceTokenizer(BaseTokenizer):
+    def __init__(self, vocab: dict, max_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.max_chars_per_word = max_chars_per_word
+        self.pad_id = vocab[PAD]
+        self.cls_id = vocab[CLS]
+        self.sep_id = vocab[SEP]
+        self.unk_id = vocab[UNK]
+
+    @classmethod
+    def from_vocab_file(cls, path: str) -> "WordPieceTokenizer":
+        vocab = {}
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                vocab[line.rstrip("\n")] = i
+        return cls(vocab)
+
+    def _wordpiece(self, word: str) -> list:
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_id]
+        pieces = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece_id = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                pid = self.vocab.get(piece)
+                if pid is not None:
+                    piece_id = pid
+                    break
+                end -= 1
+            if piece_id is None:
+                return [self.unk_id]
+            pieces.append(piece_id)
+            start = end
+        return pieces
+
+    def _encode(self, text: str, max_length: int):
+        ids = [self.cls_id]
+        for word in basic_tokenize(text):
+            ids.extend(self._wordpiece(word))
+            if len(ids) >= max_length - 1:
+                break
+        ids = ids[: max_length - 1]
+        ids.append(self.sep_id)
+        return ids
+
+
+class HashTokenizer(BaseTokenizer):
+    """Deterministic hash tokenization: same text -> same ids, same-shaped
+    pipeline as WordPiece.  Special ids mirror BERT (0=PAD, 101=CLS,
+    102=SEP)."""
+
+    def __init__(self, vocab_size: int = 30522):
+        self.vocab_size = vocab_size
+        self.pad_id = 0
+        self.cls_id = min(101, vocab_size - 3)
+        self.sep_id = min(102, vocab_size - 2)
+        self._reserved = {self.pad_id, self.cls_id, self.sep_id}
+
+    def _token_id(self, token: str) -> int:
+        import hashlib
+
+        h = int.from_bytes(
+            hashlib.blake2s(token.encode("utf-8"), digest_size=4).digest(),
+            "big",
+        )
+        tid = h % self.vocab_size
+        while tid in self._reserved:
+            tid = (tid + 1) % self.vocab_size
+        return tid
+
+    def _encode(self, text: str, max_length: int):
+        ids = [self.cls_id]
+        for word in basic_tokenize(text):
+            ids.append(self._token_id(word))
+            if len(ids) >= max_length - 1:
+                break
+        ids = ids[: max_length - 1]
+        ids.append(self.sep_id)
+        return ids
+
+
+def load_tokenizer(
+    vocab_path: Optional[str] = None, vocab_size: int = 30522
+) -> BaseTokenizer:
+    """WordPiece when a local vocab exists, hash fallback otherwise."""
+    if vocab_path:
+        import os
+
+        if os.path.exists(vocab_path):
+            return WordPieceTokenizer.from_vocab_file(vocab_path)
+    return HashTokenizer(vocab_size)

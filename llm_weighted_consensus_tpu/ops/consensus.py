@@ -19,8 +19,6 @@ Tolerance contract vs the Decimal host path: f32 accumulation, votes sum to
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

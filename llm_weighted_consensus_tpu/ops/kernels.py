@@ -60,8 +60,14 @@ def fused_consensus(votes: jax.Array, weights: jax.Array) -> jax.Array:
     """votes[M, N], weights[M] -> confidence[N] in a single fused kernel.
 
     Padding rows/cols are zero so they contribute nothing to the tally.
+    Beyond the single-block VMEM budget the jnp composition takes over.
     """
     m, n = votes.shape
+    if m > 4096 or n > 8192:
+        from .consensus import tally
+
+        _, confidence = tally(votes, weights)
+        return confidence
     votes_p = _pad_to(_pad_to(votes.astype(jnp.float32), 0, 8), 1, 128)
     weights_p = _pad_to(weights.astype(jnp.float32)[None, :], 1, 8)
     mp, np_ = votes_p.shape

@@ -1,0 +1,218 @@
+"""Encoder tests on the CPU mesh: shapes, masking invariance, determinism,
+HF weight import mapping, embedder wire contract, DeBERTa RM."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from llm_weighted_consensus_tpu.models import bert, configs, deberta, tokenizer
+from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+TINY = configs.TEST_TINY
+DTINY = configs.DEBERTA_TEST_TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return bert.init_params(jax.random.PRNGKey(0), TINY)
+
+
+def toks(batch, seq, seed=0, n_pad=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(3, TINY.vocab_size, size=(batch, seq)).astype(np.int32)
+    mask = np.ones((batch, seq), dtype=np.int32)
+    if n_pad:
+        ids[:, -n_pad:] = 0
+        mask[:, -n_pad:] = 0
+    return jnp.asarray(ids), jnp.asarray(mask)
+
+
+# -- bert ---------------------------------------------------------------------
+
+
+def test_encode_shapes_and_pool(params):
+    ids, mask = toks(3, 16)
+    hidden = bert.encode(params, ids, mask, TINY)
+    assert hidden.shape == (3, 16, TINY.hidden_size)
+    emb = bert.pool(hidden, mask, "cls")
+    assert emb.shape == (3, TINY.hidden_size)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(emb), axis=1), 1.0, atol=1e-5
+    )
+    mean_emb = bert.pool(hidden, mask, "mean")
+    assert not np.allclose(np.asarray(emb), np.asarray(mean_emb))
+
+
+def test_padding_invariance(params):
+    # embeddings must not depend on pad tokens beyond the mask
+    ids, mask = toks(2, 12, seed=1, n_pad=4)
+    e1 = bert.embed(params, ids, mask, TINY, pooling="mean")
+    ids2 = np.asarray(ids).copy()
+    ids2[:, -4:] = 7  # garbage in padded slots
+    e2 = bert.embed(params, jnp.asarray(ids2), mask, TINY, pooling="mean")
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=1e-5)
+
+
+def test_deterministic(params):
+    ids, mask = toks(2, 8, seed=2)
+    e1 = bert.embed(params, ids, mask, TINY)
+    e2 = bert.embed(params, ids, mask, TINY)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+
+
+def test_from_hf_weights_roundtrip(params):
+    """Export init params to HF naming, re-import, get identical outputs."""
+    sd = {}
+    p = jax.tree_util.tree_map(np.asarray, params)
+    sd["embeddings.word_embeddings.weight"] = p["token_embed"]
+    sd["embeddings.position_embeddings.weight"] = p["position_embed"]
+    sd["embeddings.token_type_embeddings.weight"] = p["type_embed"]
+    sd["embeddings.LayerNorm.weight"] = p["embed_ln"]["scale"]
+    sd["embeddings.LayerNorm.bias"] = p["embed_ln"]["bias"]
+    for i in range(TINY.num_layers):
+        base = f"encoder.layer.{i}"
+        for ours, hf in bert._HF_LAYER_MAP.items():
+            sd[f"{base}.{hf}.weight"] = p["layers"][ours]["kernel"][i].T
+            sd[f"{base}.{hf}.bias"] = p["layers"][ours]["bias"][i]
+        for ours, hf in bert._HF_LN_MAP.items():
+            sd[f"{base}.{hf}.weight"] = p["layers"][ours]["scale"][i]
+            sd[f"{base}.{hf}.bias"] = p["layers"][ours]["bias"][i]
+    imported = bert.from_hf_weights(sd, TINY)
+    ids, mask = toks(2, 8, seed=3)
+    np.testing.assert_allclose(
+        np.asarray(bert.embed(params, ids, mask, TINY)),
+        np.asarray(bert.embed(imported, ids, mask, TINY)),
+        atol=1e-6,
+    )
+
+
+# -- tokenizer ----------------------------------------------------------------
+
+
+def test_wordpiece_greedy_longest_match():
+    vocab = {t: i for i, t in enumerate(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "un", "##aff", "##able", "aff",
+         "hello", "world", "!"]
+    )}
+    tok = tokenizer.WordPieceTokenizer(vocab)
+    ids, mask = tok.encode_batch(["hello world!", "unaffable"], max_length=16)
+    assert ids.shape == (2, 16)
+    row0 = [i for i in ids[0] if i != tok.pad_id]
+    assert row0 == [vocab["[CLS]"], vocab["hello"], vocab["world"], vocab["!"], vocab["[SEP]"]]
+    row1 = [i for i in ids[1] if i != tok.pad_id]
+    assert row1 == [vocab["[CLS]"], vocab["un"], vocab["##aff"], vocab["##able"], vocab["[SEP]"]]
+    assert mask[0].sum() == 5 and mask[1].sum() == 5
+
+
+def test_wordpiece_unknown_word():
+    vocab = {t: i for i, t in enumerate(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "a"])}
+    tok = tokenizer.WordPieceTokenizer(vocab)
+    ids, _ = tok.encode_batch(["xyzzy"], max_length=8)
+    assert vocab["[UNK]"] in ids[0]
+
+
+def test_hash_tokenizer_deterministic_and_padded():
+    tok = tokenizer.HashTokenizer(vocab_size=512)
+    a1, m1 = tok.encode_batch(["the same text"], max_length=12)
+    a2, _ = tok.encode_batch(["the same text"], max_length=12)
+    np.testing.assert_array_equal(a1, a2)
+    b, _ = tok.encode_batch(["different text"], max_length=12)
+    assert not np.array_equal(a1, b)
+    assert a1[0, 0] == tok.cls_id
+    assert (a1[0][m1[0] == 0] == tok.pad_id).all()
+    assert a1.max() < 512
+
+
+def test_basic_tokenize():
+    assert tokenizer.basic_tokenize("Héllo, World!") == ["hello", ",", "world", "!"]
+
+
+# -- embedder -----------------------------------------------------------------
+
+
+def test_embedder_pipeline_and_wire_response():
+    emb = TpuEmbedder(
+        "test-tiny", config=configs.TEST_TINY, max_tokens=32, seed=1
+    )
+    texts = ["the answer is 42", "the answer is 42!", "bananas are yellow"]
+    vecs = emb.embed_texts(texts)
+    assert vecs.shape == (3, TINY.hidden_size)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, atol=1e-5)
+
+    resp = emb.embeddings_response(texts)
+    obj = resp.to_json_obj()
+    assert obj["object"] == "list"
+    assert len(obj["data"]) == 3
+    assert obj["data"][2]["index"] == 2
+    assert obj["usage"]["total_tokens"] == resp.usage.prompt_tokens > 0
+    assert obj["model"] == "test-tiny"
+
+
+def test_embedder_bucketing_consistency():
+    # same text embeds identically regardless of batch padding bucket
+    emb = TpuEmbedder("test-tiny", config=configs.TEST_TINY, max_tokens=32, seed=1)
+    alone = emb.embed_texts(["consistent text"])
+    batched = emb.embed_texts(["consistent text"] + ["filler"] * 4)
+    np.testing.assert_allclose(alone[0], batched[0], atol=1e-5)
+
+
+def test_embedder_cosine_consensus_integration():
+    from llm_weighted_consensus_tpu.ops.similarity import cosine_consensus_vote
+
+    emb = TpuEmbedder("test-tiny", config=configs.TEST_TINY, max_tokens=32, seed=1)
+    texts = ["answer A", "answer A", "answer A", "something wildly different 12345"]
+    conf = np.asarray(cosine_consensus_vote(jnp.asarray(emb.embed_texts(texts))))
+    assert conf.argmax() < 3
+    assert conf.sum() == pytest.approx(1.0, abs=1e-5)
+
+
+# -- deberta RM ---------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rm_params():
+    return deberta.init_params(jax.random.PRNGKey(0), DTINY)
+
+
+def test_reward_shapes_and_determinism(rm_params):
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, DTINY.vocab_size, size=(4, 24)), jnp.int32)
+    mask = jnp.ones((4, 24), jnp.int32)
+    r1 = deberta.reward(rm_params, ids, mask, DTINY)
+    r2 = deberta.reward(rm_params, ids, mask, DTINY)
+    assert r1.shape == (4,)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    assert len(set(np.asarray(r1).round(6))) > 1  # not constant
+
+
+def test_reward_padding_invariance(rm_params):
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, DTINY.vocab_size, size=(2, 16)).astype(np.int32)
+    mask = np.ones((2, 16), dtype=np.int32)
+    ids[:, -5:] = 0
+    mask[:, -5:] = 0
+    r1 = deberta.reward(rm_params, jnp.asarray(ids), jnp.asarray(mask), DTINY)
+    ids2 = ids.copy()
+    ids2[:, -5:] = 9
+    r2 = deberta.reward(rm_params, jnp.asarray(ids2), jnp.asarray(mask), DTINY)
+    np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), atol=1e-5)
+
+
+def test_reward_position_sensitivity(rm_params):
+    # disentangled attention must make reward order-sensitive
+    rng = np.random.default_rng(2)
+    seqa = rng.integers(1, DTINY.vocab_size, size=(1, 12)).astype(np.int32)
+    seqb = seqa[:, ::-1].copy()
+    mask = jnp.ones((1, 12), jnp.int32)
+    ra = deberta.reward(rm_params, jnp.asarray(seqa), mask, DTINY)
+    rb = deberta.reward(rm_params, jnp.asarray(seqb), mask, DTINY)
+    assert abs(float(ra[0]) - float(rb[0])) > 1e-6
+
+
+def test_reward_consensus_vote(rm_params):
+    rewards = jnp.asarray([2.0, 0.0, -1.0])
+    conf = np.asarray(deberta.reward_consensus_vote(rewards))
+    assert conf.sum() == pytest.approx(1.0, abs=1e-6)
+    assert conf[0] > conf[1] > conf[2]

@@ -25,26 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from .configs import BertConfig
-
-
-# ---------------------------------------------------------------------------
-# Init
-# ---------------------------------------------------------------------------
-
-
-def _dense_init(rng, in_dim, out_dim, dtype):
-    w_rng, _ = jax.random.split(rng)
-    scale = 0.02
-    return {
-        "kernel": (
-            jax.random.normal(w_rng, (in_dim, out_dim), jnp.float32) * scale
-        ).astype(dtype),
-        "bias": jnp.zeros((out_dim,), dtype),
-    }
-
-
-def _ln_init(dim, dtype):
-    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+from .layers import dense as _dense, dense_init as _dense_init, layer_norm as _layer_norm, ln_init as _ln_init
 
 
 def init_params(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> dict:
@@ -94,29 +75,6 @@ def init_params(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> dict:
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
-
-
-def _layer_norm(x, params, eps):
-    x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
-    return (
-        normed * params["scale"].astype(jnp.float32)
-        + params["bias"].astype(jnp.float32)
-    ).astype(x.dtype)
-
-
-def _dense(x, p):
-    return (
-        jnp.einsum(
-            "...i,io->...o",
-            x,
-            p["kernel"],
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
-        + p["bias"]
-    )
 
 
 def _attention(x, p, mask_bias, config: BertConfig):
@@ -186,8 +144,10 @@ def pool(
     if pooling == "cls":
         emb = hidden[:, 0, :]
     elif pooling == "mean":
-        mask = attention_mask[:, :, None].astype(hidden.dtype)
-        emb = jnp.sum(hidden * mask, axis=1) / jnp.maximum(
+        # f32 reductions regardless of activation dtype (module contract):
+        # bf16 cannot even represent token counts > 256 exactly
+        mask = attention_mask[:, :, None].astype(jnp.float32)
+        emb = jnp.sum(hidden.astype(jnp.float32) * mask, axis=1) / jnp.maximum(
             jnp.sum(mask, axis=1), 1
         )
     else:

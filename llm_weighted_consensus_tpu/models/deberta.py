@@ -25,19 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from .configs import DebertaConfig
-
-
-def _dense_init(rng, in_dim, out_dim, dtype):
-    return {
-        "kernel": (
-            jax.random.normal(rng, (in_dim, out_dim), jnp.float32) * 0.02
-        ).astype(dtype),
-        "bias": jnp.zeros((out_dim,), dtype),
-    }
-
-
-def _ln_init(dim, dtype):
-    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+from .layers import dense as _dense, dense_init as _dense_init, layer_norm as _layer_norm, ln_init as _ln_init
 
 
 def init_params(rng, config: DebertaConfig, dtype=jnp.float32) -> dict:
@@ -77,25 +65,6 @@ def init_params(rng, config: DebertaConfig, dtype=jnp.float32) -> dict:
         "head_dense": _dense_init(keys[3], h, h, dtype),
         "head_out": _dense_init(keys[4], h, 1, dtype),
     }
-
-
-def _layer_norm(x, p, eps):
-    x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
-    return (
-        out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
-    ).astype(x.dtype)
-
-
-def _dense(x, p):
-    return (
-        jnp.einsum(
-            "...i,io->...o", x, p["kernel"], preferred_element_type=jnp.float32
-        ).astype(x.dtype)
-        + p["bias"]
-    )
 
 
 def _rel_index(seq: int, k: int) -> jax.Array:

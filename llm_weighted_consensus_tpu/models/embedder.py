@@ -86,9 +86,22 @@ class TpuEmbedder:
         ids, mask = self.tokenize(texts, max_tokens)
         return self.embed_tokens(ids, mask)
 
+    MAX_DEVICE_BATCH = 4096
+
     def embed_tokens(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
         b = ids.shape[0]
-        pad_b = _bucket(b, 4096)
+        if b > self.MAX_DEVICE_BATCH:
+            # chunk oversized batches; each chunk reuses the same jit
+            # specialization (fixed bucketed shapes)
+            chunks = [
+                self.embed_tokens(
+                    ids[i : i + self.MAX_DEVICE_BATCH],
+                    mask[i : i + self.MAX_DEVICE_BATCH],
+                )
+                for i in range(0, b, self.MAX_DEVICE_BATCH)
+            ]
+            return np.concatenate(chunks, axis=0)
+        pad_b = _bucket(b, self.MAX_DEVICE_BATCH)
         if pad_b != b:
             ids = np.pad(ids, ((0, pad_b - b), (0, 0)))
             mask = np.pad(mask, ((0, pad_b - b), (0, 0)))

@@ -1,0 +1,47 @@
+"""Shared encoder building blocks (used by bert.py and deberta.py).
+
+Numerically-sensitive primitives live in exactly one place: dense matmuls
+run in the param dtype with f32 accumulation on the MXU; layernorm always
+computes in f32 regardless of the activation dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_init(rng, in_dim: int, out_dim: int, dtype) -> dict:
+    return {
+        "kernel": (
+            jax.random.normal(rng, (in_dim, out_dim), jnp.float32) * 0.02
+        ).astype(dtype),
+        "bias": jnp.zeros((out_dim,), dtype),
+    }
+
+
+def ln_init(dim: int, dtype) -> dict:
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def layer_norm(x, params: dict, eps: float):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (
+        normed * params["scale"].astype(jnp.float32)
+        + params["bias"].astype(jnp.float32)
+    ).astype(x.dtype)
+
+
+def dense(x, p: dict):
+    return (
+        jnp.einsum(
+            "...i,io->...o",
+            x,
+            p["kernel"],
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        + p["bias"]
+    )

@@ -92,7 +92,7 @@ class WordPieceTokenizer(BaseTokenizer):
         vocab = {}
         with open(path, encoding="utf-8") as f:
             for i, line in enumerate(f):
-                vocab[line.rstrip("\n")] = i
+                vocab[line.rstrip("\r\n")] = i
         return cls(vocab)
 
     def _wordpiece(self, word: str) -> list:

@@ -63,7 +63,8 @@ def fused_consensus(votes: jax.Array, weights: jax.Array) -> jax.Array:
     Beyond the single-block VMEM budget the jnp composition takes over.
     """
     m, n = votes.shape
-    if m > 4096 or n > 8192:
+    # same single-block VMEM budget as fused_cosine_vote (~8 MB f32)
+    if m > MAX_FUSED_CHOICES or n > MAX_FUSED_DIM:
         from .consensus import tally
 
         _, confidence = tally(votes, weights)

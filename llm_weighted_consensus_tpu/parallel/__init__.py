@@ -1,0 +1,25 @@
+"""Device-mesh scale-out: shardings, collectives, batch parallelism.
+
+The reference's only concurrency is single-process async IO (SURVEY §2.8);
+its "distributed backend" is HTTPS.  Here distribution is first-class and
+TPU-shaped:
+
+* ``mesh``      — mesh construction over dp/tp axes (ICI within a slice,
+  DCN across hosts comes free with jax.distributed process groups);
+* ``sharding``  — NamedSharding rules: batch over ``dp``, optional tensor
+  parallelism of attention heads + MLP over ``tp`` (bge-class models need
+  only DP — SURVEY §2.8 notes this explicitly — but TP is implemented and
+  dry-run tested so larger encoders drop in);
+* ``collectives`` — the consensus reduction as explicit ICI collectives:
+  candidates sharded over the mesh, ``all_gather`` for pairwise cosine,
+  ``psum`` for the global softmax — replacing the reference's host-side
+  tally loop with on-device communication;
+* ``batch``     — archive batch re-scoring sharded over ``dp`` (BASELINE
+  config 4).
+
+No pipeline parallelism (a 12-24 layer encoder has no use for stages) and
+no expert parallelism (no MoE) — by design, stated here per SURVEY §2.8.
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from . import batch, collectives, sharding  # noqa: F401

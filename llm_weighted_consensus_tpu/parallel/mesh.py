@@ -1,0 +1,35 @@
+"""Mesh construction over data-parallel and tensor-parallel axes."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    dp: Optional[int] = None, tp: int = 1, devices: Optional[list] = None
+) -> Mesh:
+    """A (dp, tp) mesh over the available devices.
+
+    ``dp=None`` takes every device not consumed by ``tp``.  On real slices
+    the device order from ``jax.devices()`` follows the ICI torus, so
+    neighboring tp groups ride the fastest links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if dp is None:
+        dp = len(devices) // tp
+    n = dp * tp
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:n]).reshape(dp, tp)
+    return Mesh(grid, ("dp", "tp"))
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    return make_mesh(dp=n, tp=1)

@@ -1,0 +1,86 @@
+"""Sharding rules: batch over ``dp``, encoder tensor parallelism over ``tp``.
+
+The recipe (How to Scale Your Model, public jax-ml scaling book): pick a
+mesh, annotate input/param shardings with NamedSharding, let XLA insert the
+collectives.  For the BERT encoder the TP layout is the Megatron split:
+
+* attention q/k/v kernels column-split over heads  -> P(None, "tp")
+* attention output kernel row-split                -> P("tp", None)
+* MLP in column-split, MLP out row-split           -> P(None, "tp"), P("tp", None)
+* embeddings + layernorms replicated               -> P()
+
+so each layer does two reduce-scatters' worth of comms (XLA chooses
+all-reduce/reduce-scatter over ICI).  Batches shard over ``dp``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp", None))
+
+
+# Leading axis of every stacked layer param is the layer index (scanned) —
+# shardings below apply to [layer, in, out] kernels / [layer, dim] biases.
+_TP_LAYER_SPECS = {
+    "attn_q": {"kernel": P(None, None, "tp"), "bias": P(None, "tp")},
+    "attn_k": {"kernel": P(None, None, "tp"), "bias": P(None, "tp")},
+    "attn_v": {"kernel": P(None, None, "tp"), "bias": P(None, "tp")},
+    "attn_out": {"kernel": P(None, "tp", None), "bias": P(None)},
+    "attn_ln": {"scale": P(None), "bias": P(None)},
+    "mlp_in": {"kernel": P(None, None, "tp"), "bias": P(None, "tp")},
+    "mlp_out": {"kernel": P(None, "tp", None), "bias": P(None)},
+    "mlp_ln": {"scale": P(None), "bias": P(None)},
+}
+
+
+def bert_param_specs(tp: bool = True) -> dict:
+    """PartitionSpec pytree matching models.bert param layout."""
+    layer = (
+        _TP_LAYER_SPECS
+        if tp
+        else {
+            name: {k: P(None) for k in leaf}
+            for name, leaf in _TP_LAYER_SPECS.items()
+        }
+    )
+    return {
+        "token_embed": P(),
+        "position_embed": P(),
+        "type_embed": P(),
+        "embed_ln": {"scale": P(), "bias": P()},
+        "layers": layer,
+    }
+
+
+def shard_bert_params(params: dict, mesh: Mesh, tp: bool = True) -> dict:
+    """Place a bert param pytree on the mesh with the TP layout."""
+    specs = bert_param_specs(tp=tp and mesh.shape.get("tp", 1) > 1)
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_embedder(embedder, mesh: Mesh, tp: bool = False) -> None:
+    """Wire a models.embedder.TpuEmbedder onto a mesh: params placed
+    (replicated or TP), batches split over ``dp`` via its put_batch hook."""
+    embedder.params = shard_bert_params(embedder.params, mesh, tp=tp)
+    b_sharding = batch_sharding(mesh)
+
+    def put_batch(ids, mask):
+        return (
+            jax.device_put(ids, b_sharding),
+            jax.device_put(mask, b_sharding),
+        )
+
+    embedder.put_batch = put_batch

@@ -1,0 +1,126 @@
+"""Training steps: contrastive encoder training + RM pairwise ranking.
+
+The reference has no training (SURVEY §5: "No training -> no checkpoints");
+this framework's trained-weight path needs two trainers:
+
+* ``contrastive_train_step`` — bge-style InfoNCE over (query, positive)
+  pairs with in-batch negatives: the recipe that produces the embedding
+  tables behind training-table weights;
+* ``reward_train_step``      — pairwise Bradley-Terry loss on
+  (chosen, rejected) candidate pairs for the DeBERTa RM (config 3).
+
+Both are single jitted steps over a mesh: batch sharded over ``dp``,
+gradients all-reduced by XLA (replicated params => psum on the backward
+pass), optional encoder TP via parallel.sharding.  Checkpointing is orbax
+on the param pytree (see ``save_checkpoint``/``load_checkpoint``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models import bert
+from ..models.configs import BertConfig, DebertaConfig
+
+
+def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.01):
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+# ---------------------------------------------------------------------------
+# Contrastive encoder training (InfoNCE, in-batch negatives)
+# ---------------------------------------------------------------------------
+
+
+def contrastive_loss(
+    params, q_ids, q_mask, p_ids, p_mask, config: BertConfig, temperature=0.05
+):
+    q = bert.embed(params, q_ids, q_mask, config, pooling="cls")
+    p = bert.embed(params, p_ids, p_mask, config, pooling="cls")
+    logits = (
+        jnp.einsum(
+            "bd,cd->bc",
+            q,
+            p,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        / temperature
+    )
+    labels = jnp.arange(q.shape[0])
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.mean(loss)
+
+
+@partial(jax.jit, static_argnames=("config", "optimizer"), donate_argnums=(0, 1))
+def contrastive_train_step(
+    params, opt_state, q_ids, q_mask, p_ids, p_mask, config: BertConfig, optimizer
+):
+    """One InfoNCE step; params/opt_state donated for in-place updates."""
+    loss, grads = jax.value_and_grad(contrastive_loss)(
+        params, q_ids, q_mask, p_ids, p_mask, config
+    )
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+# ---------------------------------------------------------------------------
+# Reward-model pairwise training
+# ---------------------------------------------------------------------------
+
+
+def reward_pairwise_loss(
+    params, chosen_ids, chosen_mask, rejected_ids, rejected_mask, config
+):
+    from ..models import deberta
+
+    r_chosen = deberta.reward(params, chosen_ids, chosen_mask, config)
+    r_rejected = deberta.reward(params, rejected_ids, rejected_mask, config)
+    # Bradley-Terry: -log sigmoid(r_chosen - r_rejected)
+    return jnp.mean(jax.nn.softplus(-(r_chosen - r_rejected)))
+
+
+@partial(jax.jit, static_argnames=("config", "optimizer"), donate_argnums=(0, 1))
+def reward_train_step(
+    params,
+    opt_state,
+    chosen_ids,
+    chosen_mask,
+    rejected_ids,
+    rejected_mask,
+    config: DebertaConfig,
+    optimizer,
+):
+    loss, grads = jax.value_and_grad(reward_pairwise_loss)(
+        params, chosen_ids, chosen_mask, rejected_ids, rejected_mask, config
+    )
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing (orbax)
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(path: str, params) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+
+
+def load_checkpoint(path: str, like=None):
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            return ckptr.restore(path, like)
+        return ckptr.restore(path)

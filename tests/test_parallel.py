@@ -1,0 +1,172 @@
+"""Mesh scale-out on the virtual 8-device CPU mesh: collectives parity,
+TP-sharded forward equivalence, training steps, batch re-score, graft
+entry points (SURVEY §4: multi-device without a cluster)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from llm_weighted_consensus_tpu.models import bert
+from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+from llm_weighted_consensus_tpu.ops import consensus, similarity
+from llm_weighted_consensus_tpu.parallel import (
+    batch as batch_mod,
+    collectives,
+    make_mesh,
+    sharding,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh"
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(dp=4, tp=2)
+
+
+@pytest.fixture(scope="module")
+def dp_mesh():
+    return make_mesh(dp=8, tp=1)
+
+
+def test_sharded_cosine_vote_matches_single_device(dp_mesh):
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(16, 32)).astype(np.float32)
+    dist = np.asarray(collectives.sharded_cosine_vote(jnp.asarray(emb), dp_mesh))
+    single = np.asarray(similarity.cosine_consensus_vote(jnp.asarray(emb)))
+    np.testing.assert_allclose(dist, single, atol=1e-5)
+
+
+def test_sharded_cosine_vote_ragged_n(dp_mesh):
+    # N not divisible by dp: padding must not perturb the result
+    rng = np.random.default_rng(1)
+    emb = rng.normal(size=(13, 16)).astype(np.float32)
+    dist = np.asarray(collectives.sharded_cosine_vote(jnp.asarray(emb), dp_mesh))
+    single = np.asarray(similarity.cosine_consensus_vote(jnp.asarray(emb)))
+    np.testing.assert_allclose(dist, single, atol=1e-5)
+    assert dist.shape == (13,)
+
+
+def test_sharded_tally_matches_single_device(dp_mesh):
+    rng = np.random.default_rng(2)
+    v = rng.random((24, 5)).astype(np.float32)
+    v /= v.sum(axis=1, keepdims=True)
+    w = rng.uniform(0.5, 2.0, 24).astype(np.float32)
+    dist = np.asarray(collectives.sharded_tally(jnp.asarray(v), jnp.asarray(w), dp_mesh))
+    _, single = consensus.tally(jnp.asarray(v), jnp.asarray(w))
+    np.testing.assert_allclose(dist, np.asarray(single), atol=1e-5)
+
+
+def test_tp_sharded_forward_matches_replicated(mesh):
+    params = bert.init_params(jax.random.PRNGKey(0), TEST_TINY)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(3, TEST_TINY.vocab_size, (4, 16)), jnp.int32)
+    mask = jnp.ones((4, 16), jnp.int32)
+    base = np.asarray(bert.embed(params, ids, mask, TEST_TINY))
+    sharded = sharding.shard_bert_params(params, mesh, tp=True)
+    ids_s = jax.device_put(ids, sharding.batch_sharding(mesh))
+    mask_s = jax.device_put(mask, sharding.batch_sharding(mesh))
+    out = np.asarray(bert.embed(sharded, ids_s, mask_s, TEST_TINY))
+    np.testing.assert_allclose(out, base, atol=1e-5)
+
+
+def test_shard_embedder_same_results(dp_mesh):
+    emb = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=32, seed=1)
+    texts = [f"text number {i}" for i in range(8)]
+    base = emb.embed_texts(texts)
+    sharding.shard_embedder(emb, dp_mesh)
+    out = emb.embed_texts(texts)
+    np.testing.assert_allclose(out, base, atol=1e-5)
+
+
+def test_rescore_batch_mesh_matches_local(dp_mesh):
+    rng = np.random.default_rng(4)
+    b, m, n = 19, 4, 6  # ragged batch
+    v = rng.random((b, m, n)).astype(np.float32)
+    v /= v.sum(axis=2, keepdims=True)
+    w = np.ones((b, m), dtype=np.float32)
+    _, conf_mesh = batch_mod.rescore_batch(v, w, mesh=dp_mesh)
+    _, conf_local = batch_mod.rescore_batch(v, w)
+    np.testing.assert_allclose(
+        np.asarray(conf_mesh), np.asarray(conf_local), atol=1e-6
+    )
+    assert conf_mesh.shape == (b, n)
+
+
+def test_contrastive_training_reduces_loss(dp_mesh):
+    from llm_weighted_consensus_tpu import train
+
+    config = TEST_TINY
+    params = bert.init_params(jax.random.PRNGKey(0), config)
+    params = sharding.shard_bert_params(params, dp_mesh, tp=False)
+    optimizer = train.make_optimizer(lr=1e-3)
+    opt_state = optimizer.init(params)
+    rng = np.random.default_rng(5)
+    b, s = 8, 16
+    bs = sharding.batch_sharding(dp_mesh)
+    q = jax.device_put(
+        jnp.asarray(rng.integers(3, config.vocab_size, (b, s)), jnp.int32), bs
+    )
+    p = jax.device_put(
+        jnp.asarray(rng.integers(3, config.vocab_size, (b, s)), jnp.int32), bs
+    )
+    ones = jax.device_put(jnp.ones((b, s), jnp.int32), bs)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train.contrastive_train_step(
+            params, opt_state, q, ones, p, ones, config, optimizer
+        )
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_reward_training_reduces_loss():
+    from llm_weighted_consensus_tpu import train
+    from llm_weighted_consensus_tpu.models import deberta
+    from llm_weighted_consensus_tpu.models.configs import DEBERTA_TEST_TINY
+
+    config = DEBERTA_TEST_TINY
+    params = deberta.init_params(jax.random.PRNGKey(1), config)
+    optimizer = train.make_optimizer(lr=1e-3)
+    opt_state = optimizer.init(params)
+    rng = np.random.default_rng(6)
+    chosen = jnp.asarray(rng.integers(1, config.vocab_size, (4, 16)), jnp.int32)
+    rejected = jnp.asarray(rng.integers(1, config.vocab_size, (4, 16)), jnp.int32)
+    ones = jnp.ones((4, 16), jnp.int32)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train.reward_train_step(
+            params, opt_state, chosen, ones, rejected, ones, config, optimizer
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from llm_weighted_consensus_tpu import train
+
+    params = bert.init_params(jax.random.PRNGKey(2), TEST_TINY)
+    path = str(tmp_path / "ckpt")
+    train.save_checkpoint(path, params)
+    restored = train.load_checkpoint(path, like=params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params,
+        restored,
+    )
+
+
+def test_graft_entry_points():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8,)
+    assert float(jnp.sum(out)) == pytest.approx(1.0, abs=1e-5)
+    ge.dryrun_multichip(8)

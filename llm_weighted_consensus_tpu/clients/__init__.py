@@ -5,4 +5,4 @@ the pure core but no JAX.  Device math is reached through the ``weights`` /
 ``ops`` seams so the IO path stays importable everywhere.
 """
 
-from . import chat  # noqa: F401
+from . import chat, multichat, score  # noqa: F401

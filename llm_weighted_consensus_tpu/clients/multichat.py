@@ -1,0 +1,256 @@
+"""Multichat client: one request fans out to many generator models.
+
+The reference defines multichat only as response types + identity
+(SURVEY §2.10: "one request, many models, choices = each model's answer");
+this implements the client for real.  A score panel's judges define the
+generator slots: judges are deduplicated by ``multichat_id`` (weight /
+output_mode / synthetic_reasoning / top_logprobs reset — llm/mod.rs:538-548)
+and duplicates of the same generator occupy consecutive slots
+(model/mod.rs:153-178) — i.e. extra samples from that generator.
+
+Streaming protocol mirrors the score engine's: slots stream interleaved,
+per-slot errors are error choices (never request failures), unary is the
+fold of the stream.  ``StreamingSelfConsistency`` adds the incremental
+on-device consensus update (BASELINE config 5): each finished candidate is
+embedded and the cosine consensus recomputed, so consumers watch confidence
+converge while slower generators are still streaming.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ..errors import ChatError, ScoreChatError, to_response_error
+from ..identity.model import Model
+from ..types.base import fold_chunks
+from ..types.chat_response import Delta as ChatDelta
+from ..types.multichat_response import (
+    ChatCompletion,
+    ChatCompletionChunk,
+    StreamingChoice,
+)
+from ..types.score_response import CompletionMetadata
+from ..utils import response_id
+from .chat import ChatClient, _try_join
+from .score import (
+    ScoreError,
+    fetch_archived_for_choices_and_messages,
+    fetch_or_validate_score_model,
+    merge_streams,
+)
+
+RESPONSE_ID_PREFIX = "mchcpl"
+
+
+def generator_slots(model: Model) -> list:
+    """(slot_index, llm) per generator slot, ordered by multichat_index.
+
+    Every judge occupies exactly one slot; judges sharing a multichat_id
+    are the same generator sampled multiple times.
+    """
+    return [
+        (llm.multichat_index, llm)
+        for llm in sorted(model.llms, key=lambda l: l.multichat_index)
+    ]
+
+
+class MultichatClient:
+    def __init__(
+        self,
+        chat_client: ChatClient,
+        model_fetcher,
+        archive_fetcher=None,
+    ) -> None:
+        from .. import archive as archive_mod
+
+        self.chat_client = chat_client
+        self.model_fetcher = model_fetcher
+        self.archive_fetcher = archive_fetcher or archive_mod.UnimplementedFetcher()
+
+    async def create_unary(self, ctx, params) -> ChatCompletion:
+        stream = await self.create_streaming(ctx, params)
+        chunks = []
+        try:
+            async for item in stream:
+                if isinstance(item, ScoreError):
+                    raise item
+                chunks.append(item)
+        finally:
+            await stream.aclose()
+        return ChatCompletion.from_streaming(fold_chunks(chunks))
+
+    async def create_streaming(self, ctx, params):
+        from .. import archive as archive_mod
+
+        created = int(time.time())
+        resp_id = response_id(RESPONSE_ID_PREFIX, created)
+
+        model, completions = await _try_join(
+            fetch_or_validate_score_model(self.model_fetcher, ctx, params.model),
+            fetch_archived_for_choices_and_messages(
+                self.archive_fetcher, ctx, [], params.messages
+            ),
+        )
+        request = params.clone()
+        request.model = model.id
+        request.messages = archive_mod.replace_archive_messages(
+            completions, request.messages
+        )
+        return self._stream(ctx, resp_id, created, model, request)
+
+    async def _stream(self, ctx, resp_id, created, model, request):
+        streams = [
+            self._slot_stream(ctx, resp_id, created, slot, llm, request)
+            for slot, llm in generator_slots(model)
+        ]
+        async for chunk in merge_streams(streams):
+            yield chunk
+
+    def _slot_params(self, llm, request, slot: int):
+        """The upstream chat request for one generator slot: the judge's
+        sampling surface minus ballot forcing (the multichat-reset fields)."""
+        from .params import base_chat_params, wrap_messages
+
+        base = llm.base
+        # identical generators must not produce identical samples: offset a
+        # caller-provided seed per slot
+        seed = request.seed + slot if request.seed is not None else None
+        return base_chat_params(
+            base, request, wrap_messages(base, request.messages), seed=seed
+        )
+
+    async def _slot_stream(self, ctx, resp_id, created, slot, llm, request):
+        def error_chunk(err) -> ChatCompletionChunk:
+            return ChatCompletionChunk(
+                id=resp_id,
+                choices=[
+                    StreamingChoice(
+                        delta=ChatDelta(),
+                        finish_reason="error",
+                        index=slot,
+                        logprobs=None,
+                        error=to_response_error(ScoreChatError(err))
+                        if isinstance(err, ChatError)
+                        else to_response_error(err),
+                        model=llm.multichat_id,
+                        model_index=llm.multichat_index,
+                        completion_metadata=None,
+                    )
+                ],
+                created=created,
+                model=request.model,
+                usage=None,
+            )
+
+        try:
+            stream = await self.chat_client.create_streaming(
+                ctx, self._slot_params(llm, request, slot)
+            )
+        except ChatError as e:
+            yield error_chunk(e)
+            return
+        except Exception as e:
+            # per-slot isolation covers unexpected failures too
+            yield error_chunk(to_response_error(e))
+            return
+
+        try:
+            async for item in stream:
+                if isinstance(item, ChatError):
+                    yield error_chunk(item)
+                    return
+                yield ChatCompletionChunk(
+                    id=resp_id,
+                    choices=[
+                        StreamingChoice(
+                            delta=choice.delta,
+                            finish_reason=choice.finish_reason,
+                            index=slot,
+                            logprobs=choice.logprobs,
+                            error=None,
+                            model=llm.multichat_id,
+                            model_index=llm.multichat_index,
+                            completion_metadata=CompletionMetadata(
+                                id=item.id,
+                                created=item.created,
+                                model=item.model,
+                                service_tier=item.service_tier,
+                                system_fingerprint=item.system_fingerprint,
+                                usage=item.usage,
+                                provider=item.provider,
+                            ),
+                        )
+                        for choice in item.choices
+                        if choice.index == 0
+                    ],
+                    created=created,
+                    model=request.model,
+                    usage=None,
+                )
+        finally:
+            aclose = getattr(stream, "aclose", None)
+            if aclose is not None:
+                await aclose()
+
+
+# ---------------------------------------------------------------------------
+# Streaming incremental consensus (BASELINE config 5)
+# ---------------------------------------------------------------------------
+
+
+class StreamingSelfConsistency:
+    """Fold a multichat stream into a live consensus distribution.
+
+    As each candidate finishes, it is embedded on device and the cosine
+    consensus vote recomputed over the completed set — consumers see
+    ``confidence`` tighten while slow generators are still streaming.
+    """
+
+    def __init__(self, embedder, temperature: float = 0.05):
+        self.embedder = embedder
+        self.temperature = temperature
+        self.texts: dict = {}
+        self.embeddings: dict = {}  # slot -> cached vector (embed once)
+        self.failed: set = set()
+        self.confidence: dict = {}
+
+    def push_chunk(self, chunk: ChatCompletionChunk) -> Optional[dict]:
+        """Returns {slot: confidence} when the distribution updates."""
+        updated = False
+        for choice in chunk.choices:
+            slot = choice.index
+            if choice.delta.content:
+                self.texts[slot] = self.texts.get(slot, "") + choice.delta.content
+            if choice.error is not None or choice.finish_reason == "error":
+                # errored generators contribute nothing to the consensus
+                self.failed.add(slot)
+                continue
+            if (
+                choice.finish_reason is not None
+                and slot not in self.embeddings
+                and slot not in self.failed
+            ):
+                text = self.texts.get(slot, "")
+                self.embeddings[slot] = self.embedder.embed_texts([text])[0]
+                updated = True
+        if not updated or len(self.embeddings) < 2:
+            return None
+        return self._recompute()
+
+    def _recompute(self) -> dict:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops.kernels import fused_cosine_vote
+
+        slots = sorted(self.embeddings)
+        vecs = np.stack([self.embeddings[s] for s in slots])
+        conf = fused_cosine_vote(
+            jnp.asarray(vecs), temperature=self.temperature
+        )
+        self.confidence = {
+            slot: float(c) for slot, c in zip(slots, list(conf))
+        }
+        return dict(self.confidence)

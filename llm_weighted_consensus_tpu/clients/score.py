@@ -759,12 +759,10 @@ class ScoreClient:
 
     def _judge_chat_params(self, llm, request, ballot_json, keys):
         """Assemble the judge's upstream chat request (client.rs:488-743)."""
+        from .params import base_chat_params, wrap_messages
+
         base = llm.base
-        messages = list(request.messages)
-        if base.prefix_messages:
-            messages = list(base.prefix_messages) + messages
-        if base.suffix_messages:
-            messages = messages + list(base.suffix_messages)
+        messages = wrap_messages(base, request.messages)
 
         # ballot goes into (or creates) the trailing system message
         # (client.rs:533-572)
@@ -816,35 +814,16 @@ class ScoreClient:
                 )
             )
 
-        return chat_request.ChatCompletionCreateParams(
-            messages=messages,
-            model=base.model,
-            frequency_penalty=base.frequency_penalty,
-            logit_bias=base.logit_bias,
-            logprobs=True if base.top_logprobs is not None else None,
-            max_completion_tokens=base.max_completion_tokens,
-            presence_penalty=base.presence_penalty,
-            response_format=response_format,
+        return base_chat_params(
+            base,
+            request,
+            messages,
             seed=request.seed,
-            service_tier=request.service_tier,
-            stop=base.stop,
-            stream=request.stream,
-            stream_options=request.stream_options,
-            temperature=base.temperature,
-            tool_choice=tool_choice,
-            tools=tools,
+            logprobs=True if base.top_logprobs is not None else None,
             top_logprobs=base.top_logprobs,
-            top_p=base.top_p,
-            max_tokens=base.max_tokens,
-            min_p=base.min_p,
-            provider=base.provider,
-            reasoning=base.reasoning,
-            repetition_penalty=base.repetition_penalty,
-            top_a=base.top_a,
-            top_k=base.top_k,
-            usage=request.usage,
-            verbosity=base.verbosity,
-            models=base.models,
+            response_format=response_format,
+            tools=tools,
+            tool_choice=tool_choice,
         )
 
     @staticmethod

@@ -1,0 +1,13 @@
+"""SSE HTTP gateway: the serving shell around the consensus engine.
+
+Parity target: reference src/main.rs — env-first config, POST
+/chat/completions and /score/completions with SSE streaming + ``[DONE]``
+terminator, unary JSON when ``stream`` is false, uniform
+``{code, message}`` error bodies.  Extended beyond the reference with the
+endpoints its types promise but its binary never serves:
+/multichat/completions (the fan-out generator) and /embeddings (the on-TPU
+encoder).
+"""
+
+from .config import Config  # noqa: F401
+from .gateway import build_app  # noqa: F401

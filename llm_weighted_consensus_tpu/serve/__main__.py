@@ -1,0 +1,166 @@
+"""Service entry point: ``python -m llm_weighted_consensus_tpu.serve``.
+
+Wires env config into the client stack (main.rs wiring parity: default
+clients + unimplemented fetchers unless stores are configured) and serves.
+``--fake-upstream`` starts a loopback scripted provider and points the
+chat client at it — the zero-key local demo / verification mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+
+from aiohttp import web
+
+from .. import archive, registry
+from ..clients.chat import AiohttpTransport, ApiBase, DefaultChatClient
+from ..clients.multichat import MultichatClient
+from ..clients.score import ScoreClient
+from ..weights import WeightFetchers
+from .config import Config, load_dotenv
+from .gateway import build_app
+
+FAKE_PORT = 5990
+
+
+async def _fake_upstream(request: web.Request) -> web.StreamResponse:
+    """A scripted judge provider: finds the ballot in the system prompt and
+    votes for the first key; plain chat otherwise."""
+    body = await request.json()
+    content = "This is a fake upstream completion."
+    for message in reversed(body.get("messages", [])):
+        if message.get("role") == "system" and "Select the response:" in str(
+            message.get("content", "")
+        ):
+            text = message["content"]
+            ballot = json.loads(
+                text.split("Select the response:\n\n", 1)[1].split(
+                    "\n\nOutput", 1
+                )[0]
+            )
+            content = f"I select {random.choice(list(ballot))}"
+            break
+    resp = web.StreamResponse(
+        headers={"content-type": "text/event-stream"}
+    )
+    await resp.prepare(request)
+    for i, frag in enumerate((content[: len(content) // 2], content[len(content) // 2 :])):
+        chunk = {
+            "id": "fake-1",
+            "object": "chat.completion.chunk",
+            "created": 0,
+            "model": body.get("model", "fake"),
+            "choices": [
+                {
+                    "index": 0,
+                    "delta": (
+                        {"role": "assistant", "content": frag}
+                        if i == 0
+                        else {"content": frag}
+                    ),
+                    "finish_reason": None,
+                }
+            ],
+        }
+        await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+    final = {
+        "id": "fake-1",
+        "object": "chat.completion.chunk",
+        "created": 0,
+        "model": body.get("model", "fake"),
+        "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+        "usage": {"prompt_tokens": 10, "completion_tokens": 10, "total_tokens": 20},
+    }
+    await resp.write(f"data: {json.dumps(final)}\n\ndata: [DONE]\n\n".encode())
+    return resp
+
+
+def build_service(config: Config, fake_upstream: bool = False):
+    api_bases = config.api_bases()
+    if fake_upstream:
+        api_bases = [ApiBase(f"http://127.0.0.1:{FAKE_PORT}/v1", "fake-key")]
+    store = archive.InMemoryArchive()
+    chat_client = DefaultChatClient(
+        AiohttpTransport(),
+        api_bases,
+        backoff=config.backoff_policy(),
+        user_agent=config.openai_user_agent,
+        x_title=config.openai_x_title,
+        referer=config.openai_referer,
+        first_chunk_timeout_ms=config.first_chunk_timeout_millis,
+        other_chunk_timeout_ms=config.other_chunk_timeout_millis,
+        archive_fetcher=store,
+    )
+    model_registry = registry.InMemoryModelRegistry()
+    embedder = None
+    weight_fetchers = WeightFetchers()
+    if config.embedder_model:
+        from ..models.embedder import TpuEmbedder
+        from ..models.tokenizer import load_tokenizer
+        from ..weights.training_table import TpuTrainingTableFetcher
+
+        embedder = TpuEmbedder(
+            config.embedder_model,
+            tokenizer=load_tokenizer(config.embedder_vocab),
+            max_tokens=config.embedder_max_tokens,
+        )
+        weight_fetchers = WeightFetchers(
+            training_table_fetcher=TpuTrainingTableFetcher(embedder)
+        )
+    score_client = ScoreClient(
+        chat_client,
+        model_registry,
+        weight_fetchers=weight_fetchers,
+        archive_fetcher=store,
+    )
+    multichat_client = MultichatClient(
+        chat_client, model_registry, archive_fetcher=store
+    )
+    return build_app(chat_client, score_client, multichat_client, embedder)
+
+
+async def _serve(config: Config, fake_upstream: bool) -> None:
+    if fake_upstream:
+        fake_app = web.Application()
+        fake_app.router.add_post("/v1/chat/completions", _fake_upstream)
+        fake_runner = web.AppRunner(fake_app)
+        await fake_runner.setup()
+        await web.TCPSite(fake_runner, "127.0.0.1", FAKE_PORT).start()
+
+    app = build_service(config, fake_upstream=fake_upstream)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, config.address, config.port).start()
+    print(f"listening on {config.address}:{config.port}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("llm-weighted-consensus-tpu gateway")
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--address", default=None)
+    parser.add_argument(
+        "--fake-upstream",
+        action="store_true",
+        help="serve against a loopback scripted provider (no API keys)",
+    )
+    args = parser.parse_args()
+    load_dotenv()
+    config = Config.from_env()
+    if args.port is not None:
+        config.port = args.port
+    if args.address is not None:
+        config.address = args.address
+    if not args.fake_upstream and not config.openai_apis:
+        raise SystemExit(
+            "Either OPENAI_APIS or both OPENAI_API_BASE and OPENAI_API_KEY "
+            "must be set (or pass --fake-upstream)"
+        )
+    asyncio.run(_serve(config, args.fake_upstream))
+
+
+if __name__ == "__main__":
+    main()

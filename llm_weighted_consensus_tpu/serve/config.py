@@ -1,0 +1,124 @@
+"""Env-first service configuration.
+
+Parity: the reference's 16 env vars (main.rs:3-37) with identical names and
+defaults, plus TPU-framework additions (encoder + mesh flags).  ``.env``
+loading mirrors dotenv: simple KEY=VALUE lines, environment wins.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils import jsonutil
+
+
+def load_dotenv(path: str = ".env") -> None:
+    if not os.path.exists(path):
+        return
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            if line.startswith("export "):
+                line = line[len("export "):]
+            key, _, value = line.partition("=")
+            value = value.strip()
+            # dotenv-style quoted values
+            if len(value) >= 2 and value[0] == value[-1] and value[0] in "'\"":
+                value = value[1:-1]
+            os.environ.setdefault(key.strip(), value)
+
+
+@dataclass
+class Config:
+    # backoff (main.rs:5-16)
+    backoff_initial_interval_millis: float = 100.0
+    backoff_randomization_factor: float = 0.5
+    backoff_multiplier: float = 1.5
+    backoff_max_interval_millis: float = 1000.0
+    backoff_max_elapsed_time_millis: float = 40000.0
+    # stream timeouts (main.rs:17-20)
+    first_chunk_timeout_millis: float = 10000.0
+    other_chunk_timeout_millis: float = 60000.0
+    # upstream endpoints (main.rs:21-33)
+    openai_apis: list = field(default_factory=list)  # [{api_base, api_key}]
+    openai_user_agent: Optional[str] = None
+    openai_x_title: Optional[str] = None
+    openai_referer: Optional[str] = None
+    # bind (main.rs:34-37)
+    address: str = "0.0.0.0"
+    port: int = 5000
+    # TPU-framework additions
+    embedder_model: Optional[str] = None  # e.g. "bge-small-en"
+    embedder_vocab: Optional[str] = None  # path to vocab.txt
+    embedder_max_tokens: int = 512
+    mesh_dp: Optional[int] = None
+    mesh_tp: int = 1
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "Config":
+        env = dict(os.environ if env is None else env)
+
+        def get_f(name, default):
+            return float(env.get(name, default))
+
+        apis_json = env.get("OPENAI_APIS")
+        if apis_json:
+            apis = jsonutil.loads(apis_json)
+        else:
+            base, key = env.get("OPENAI_API_BASE"), env.get("OPENAI_API_KEY")
+            if base and key:
+                apis = [{"api_base": base, "api_key": key}]
+            else:
+                apis = []
+        return cls(
+            backoff_initial_interval_millis=get_f(
+                "BACKOFF_INITIAL_INTERVAL_MILLIS", 100
+            ),
+            backoff_randomization_factor=get_f(
+                "BACKOFF_RANDOMIZATION_FACTOR", 0.5
+            ),
+            backoff_multiplier=get_f("BACKOFF_MULTIPLIER", 1.5),
+            backoff_max_interval_millis=get_f(
+                "BACKOFF_MAX_INTERVAL_MILLIS", 1000
+            ),
+            backoff_max_elapsed_time_millis=get_f(
+                "BACKOFF_MAX_ELAPSED_TIME_MILLIS", 40000
+            ),
+            first_chunk_timeout_millis=get_f(
+                "FIRST_CHUNK_TIMEOUT_MILLIS", 10000
+            ),
+            other_chunk_timeout_millis=get_f(
+                "OTHER_CHUNK_TIMEOUT_MILLIS", 60000
+            ),
+            openai_apis=apis,
+            openai_user_agent=env.get("OPENAI_USER_AGENT"),
+            openai_x_title=env.get("OPENAI_X_TITLE"),
+            openai_referer=env.get("OPENAI_REFERER"),
+            address=env.get("ADDRESS", "0.0.0.0"),
+            port=int(env.get("PORT", 5000)),
+            embedder_model=env.get("EMBEDDER_MODEL"),
+            embedder_vocab=env.get("EMBEDDER_VOCAB"),
+            embedder_max_tokens=int(env.get("EMBEDDER_MAX_TOKENS", 512)),
+            mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
+            mesh_tp=int(env.get("MESH_TP", 1)),
+        )
+
+    def backoff_policy(self):
+        from ..clients.chat import BackoffPolicy
+
+        return BackoffPolicy(
+            initial_interval_ms=self.backoff_initial_interval_millis,
+            randomization_factor=self.backoff_randomization_factor,
+            multiplier=self.backoff_multiplier,
+            max_interval_ms=self.backoff_max_interval_millis,
+            max_elapsed_ms=self.backoff_max_elapsed_time_millis,
+        )
+
+    def api_bases(self) -> list:
+        from ..clients.chat import ApiBase
+
+        return [ApiBase.from_json_obj(a) for a in self.openai_apis]

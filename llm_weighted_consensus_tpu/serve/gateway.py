@@ -1,0 +1,175 @@
+"""The aiohttp application: SSE endpoints over the consensus engine.
+
+Frame semantics (main.rs:142-232): streaming responses are SSE ``data:``
+frames — chunk JSON, or ``{code, message}`` ResponseError JSON for
+mid-stream errors — terminated by ``data: [DONE]``.  Pre-stream failures
+and unary failures map to HTTP status + the error's message JSON.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+
+from ..errors import ScoreError, StatusError, to_response_error
+from ..types.base import SchemaError
+from ..types.chat_request import ChatCompletionCreateParams as ChatParams
+from ..types.embeddings import CreateEmbeddingParams
+from ..types.multichat_request import (
+    ChatCompletionCreateParams as MultichatParams,
+)
+from ..types.score_request import ChatCompletionCreateParams as ScoreParams
+from ..utils import jsonutil
+
+DONE = b"data: [DONE]\n\n"
+SSE_HEADERS = {
+    "content-type": "text/event-stream",
+    "cache-control": "no-cache",
+}
+
+
+def _error_response(e: Exception) -> web.Response:
+    if isinstance(e, StatusError):
+        status, message = e.status(), e.message()
+        body = jsonutil.dumps(message)
+    else:
+        # uniform {code, message} shape for unexpected failures
+        status = 500
+        body = jsonutil.dumps({"code": 500, "message": str(e)})
+    return web.Response(
+        status=status, text=body, content_type="application/json"
+    )
+
+
+def _frame(obj) -> bytes:
+    return b"data: " + jsonutil.dumps(obj).encode("utf-8") + b"\n\n"
+
+
+async def _respond_streaming(request: web.Request, stream) -> web.StreamResponse:
+    resp = web.StreamResponse(headers=SSE_HEADERS)
+    await resp.prepare(request)
+    try:
+        async for item in stream:
+            if isinstance(item, Exception):
+                payload = to_response_error(item).to_json_obj()
+            else:
+                payload = item.to_json_obj()
+            await resp.write(_frame(payload))
+        await resp.write(DONE)
+    finally:
+        aclose = getattr(stream, "aclose", None)
+        if aclose is not None:
+            await aclose()
+    return resp
+
+
+def _make_handler(params_cls, create_streaming, create_unary):
+    async def handler(request: web.Request):
+        try:
+            body = jsonutil.loads(await request.text())
+            params = params_cls.from_json_obj(body)
+        except (ValueError, SchemaError) as e:
+            return web.Response(
+                status=400,
+                text=jsonutil.dumps({"code": 400, "message": str(e)}),
+                content_type="application/json",
+            )
+        ctx = request.headers.get("authorization")
+        if params.stream:
+            try:
+                stream = await create_streaming(ctx, params)
+            except Exception as e:
+                return _error_response(e)
+            return await _respond_streaming(request, stream)
+        try:
+            result = await create_unary(ctx, params)
+        except Exception as e:
+            return _error_response(e)
+        return web.Response(
+            text=result.to_json(), content_type="application/json"
+        )
+
+    return handler
+
+
+def build_app(
+    chat_client,
+    score_client,
+    multichat_client=None,
+    embedder=None,
+) -> web.Application:
+    app = web.Application()
+    app.router.add_post(
+        "/chat/completions",
+        _make_handler(
+            ChatParams,
+            chat_client.create_streaming,
+            chat_client.create_unary,
+        ),
+    )
+    app.router.add_post(
+        "/score/completions",
+        _make_handler(
+            ScoreParams,
+            score_client.create_streaming,
+            score_client.create_unary,
+        ),
+    )
+    if multichat_client is not None:
+        app.router.add_post(
+            "/multichat/completions",
+            _make_handler(
+                MultichatParams,
+                multichat_client.create_streaming,
+                multichat_client.create_unary,
+            ),
+        )
+    if embedder is not None:
+        app.router.add_post("/embeddings", _embeddings_handler(embedder))
+
+    async def healthz(request):
+        return web.json_response({"ok": True})
+
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
+def _embeddings_handler(embedder):
+    async def handler(request: web.Request):
+        try:
+            params = CreateEmbeddingParams.from_json_obj(
+                jsonutil.loads(await request.text())
+            )
+        except (ValueError, SchemaError) as e:
+            return web.Response(
+                status=400,
+                text=jsonutil.dumps({"code": 400, "message": str(e)}),
+                content_type="application/json",
+            )
+        if params.model and params.model != embedder.model_name:
+            return web.Response(
+                status=400,
+                text=jsonutil.dumps(
+                    {
+                        "code": 400,
+                        "message": f"unknown embeddings model {params.model!r}; "
+                        f"this gateway serves {embedder.model_name!r}",
+                    }
+                ),
+                content_type="application/json",
+            )
+        import asyncio
+
+        try:
+            # the device forward blocks; keep the event loop responsive
+            resp = await asyncio.get_running_loop().run_in_executor(
+                None, embedder.embeddings_response, params.inputs()
+            )
+        except Exception as e:
+            return _error_response(e)
+        return web.Response(
+            text=resp.to_json(), content_type="application/json"
+        )
+
+    return handler

@@ -1,0 +1,332 @@
+"""Gateway: SSE frames + [DONE], unary JSON, error bodies, env config
+(main.rs:142-232 parity), /multichat and /embeddings extensions."""
+
+import asyncio
+import json
+import random
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_weighted_consensus_tpu import archive, registry
+from llm_weighted_consensus_tpu.ballot import PrefixTree
+from llm_weighted_consensus_tpu.clients.chat import (
+    ApiBase,
+    BackoffPolicy,
+    DefaultChatClient,
+)
+from llm_weighted_consensus_tpu.clients.multichat import MultichatClient
+from llm_weighted_consensus_tpu.clients.score import ScoreClient
+from llm_weighted_consensus_tpu.identity.model import ModelBase
+from llm_weighted_consensus_tpu.serve import Config, build_app
+
+from fakes import FakeTransport, Script, chunk_obj
+
+SEED = 11
+NO_RETRY = BackoffPolicy(max_elapsed_ms=0)
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def make_app(scripts, embedder=None):
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    reg = registry.InMemoryModelRegistry()
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat, reg, archive_fetcher=store,
+        rng_factory=lambda: random.Random(SEED),
+    )
+    multichat = MultichatClient(chat, reg, archive_fetcher=store)
+    return build_app(chat, score, multichat, embedder), transport
+
+
+def ballot_keys(n):
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, n, 20)
+    return {idx: k for k, idx in tree.key_indices(rng)}
+
+
+def inline_model(judges):
+    model = ModelBase.from_json_obj({"llms": judges}).into_model_validate()
+    return {"llms": [llm.base.to_json_obj() for llm in model.llms]}
+
+
+def post_json(client, path, obj):
+    # jsonutil handles Decimal weights; stdlib json cannot
+    from llm_weighted_consensus_tpu.utils import jsonutil
+
+    return client.post(
+        path,
+        data=jsonutil.dumps(obj),
+        headers={"content-type": "application/json"},
+    )
+
+
+async def with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def sse_events(text):
+    events = []
+    for block in text.split("\n\n"):
+        if block.startswith("data: "):
+            events.append(block[len("data: "):])
+    return events
+
+
+# -- /chat/completions --------------------------------------------------------
+
+
+def test_chat_unary_json():
+    app, _ = make_app([Script([chunk_obj("hi there", finish="stop")])])
+
+    async def run(client):
+        resp = await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "q"}]},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["content"] == "hi there"
+
+    go(with_client(app, run))
+
+
+def test_chat_streaming_sse_with_done():
+    app, _ = make_app([Script([chunk_obj("a"), chunk_obj("b", finish="stop")])])
+
+    async def run(client):
+        resp = await client.post(
+            "/chat/completions",
+            json={
+                "model": "m",
+                "stream": True,
+                "messages": [{"role": "user", "content": "q"}],
+            },
+        )
+        assert resp.status == 200
+        assert resp.headers["content-type"].startswith("text/event-stream")
+        events = sse_events(await resp.text())
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        assert chunks[0]["object"] == "chat.completion.chunk"
+        contents = [
+            c["choices"][0]["delta"].get("content")
+            for c in chunks
+            if c["choices"]
+        ]
+        assert "a" in contents and "b" in contents
+
+    go(with_client(app, run))
+
+
+def test_chat_upstream_failure_maps_status():
+    app, _ = make_app([Script(status=503, body=b'{"busy": 1}')])
+
+    async def run(client):
+        resp = await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "q"}]},
+        )
+        assert resp.status == 503
+        body = await resp.json()
+        assert body["kind"] == "chat"
+
+    go(with_client(app, run))
+
+
+def test_malformed_body_is_400():
+    app, _ = make_app([])
+
+    async def run(client):
+        resp = await client.post("/chat/completions", json={"model": "m"})
+        assert resp.status == 400
+        body = await resp.json()
+        assert body["code"] == 400
+        assert "messages" in str(body["message"])
+
+    go(with_client(app, run))
+
+
+# -- /score/completions -------------------------------------------------------
+
+
+def test_score_streaming_protocol_over_http():
+    keys = ballot_keys(2)
+    app, _ = make_app(
+        [Script([chunk_obj(f"pick {keys[1]}", finish="stop")])]
+    )
+
+    async def run(client):
+        resp = await post_json(
+            client,
+            "/score/completions",
+            {
+                "stream": True,
+                "messages": [{"role": "user", "content": "q"}],
+                "model": inline_model([{"model": "j1"}]),
+                "choices": ["first", "second"],
+            },
+        )
+        assert resp.status == 200
+        events = sse_events(await resp.text())
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        # initial chunk: both candidates finished
+        assert [c["index"] for c in chunks[0]["choices"]] == [0, 1]
+        # final frame carries weight/confidence
+        final = chunks[-1]
+        cand = {c["index"]: c for c in final["choices"] if c["index"] < 2}
+        assert cand[1]["confidence"] == 1  # bare JSON number (Decimal exact)
+        assert final["usage"] is not None
+
+    go(with_client(app, run))
+
+
+def test_score_unary_and_expected_two_choices():
+    app, _ = make_app([])
+
+    async def run(client):
+        resp = await post_json(
+            client,
+            "/score/completions",
+            {
+                "messages": [{"role": "user", "content": "q"}],
+                "model": inline_model([{"model": "j1"}]),
+                "choices": ["only"],
+            },
+        )
+        assert resp.status == 400
+        body = await resp.json()
+        assert body["error"]["kind"] == "expected_two_or_more_choices"
+
+    go(with_client(app, run))
+
+
+def test_score_all_failed_error_frame_in_stream():
+    app, _ = make_app([Script(status=418, body=b"{}")])
+
+    async def run(client):
+        resp = await post_json(
+            client,
+            "/score/completions",
+            {
+                "stream": True,
+                "messages": [{"role": "user", "content": "q"}],
+                "model": inline_model([{"model": "j1"}]),
+                "choices": ["a", "b"],
+            },
+        )
+        events = sse_events(await resp.text())
+        assert events[-1] == "[DONE]"
+        error_frame = json.loads(events[-2])
+        assert error_frame["code"] == 418
+        assert error_frame["message"]["error"]["kind"] == "all_votes_failed"
+
+    go(with_client(app, run))
+
+
+# -- /multichat/completions ---------------------------------------------------
+
+
+def test_multichat_endpoint():
+    app, _ = make_app(
+        [
+            Script([chunk_obj("answer one", model="g1", finish="stop")]),
+            Script([chunk_obj("answer two", model="g2", finish="stop")]),
+        ]
+    )
+
+    async def run(client):
+        resp = await post_json(
+            client,
+            "/multichat/completions",
+            {
+                "messages": [{"role": "user", "content": "q"}],
+                "model": inline_model([{"model": "g1"}, {"model": "g2"}]),
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        texts = {c["message"]["content"] for c in body["choices"]}
+        assert texts == {"answer one", "answer two"}
+        assert {c["index"] for c in body["choices"]} == {0, 1}
+
+    go(with_client(app, run))
+
+
+# -- /embeddings --------------------------------------------------------------
+
+
+def test_embeddings_endpoint():
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    embedder = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=32)
+    app, _ = make_app([], embedder=embedder)
+
+    async def run(client):
+        resp = await client.post(
+            "/embeddings",
+            json={"model": "test-tiny", "input": ["hello", "world"]},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "list"
+        assert len(body["data"]) == 2
+        assert len(body["data"][0]["embedding"]) == TEST_TINY.hidden_size
+        assert body["usage"]["total_tokens"] > 0
+
+    go(with_client(app, run))
+
+
+def test_healthz():
+    app, _ = make_app([])
+
+    async def run(client):
+        resp = await client.get("/healthz")
+        assert (await resp.json()) == {"ok": True}
+
+    go(with_client(app, run))
+
+
+# -- config -------------------------------------------------------------------
+
+
+def test_config_env_parity():
+    env = {
+        "OPENAI_APIS": '[{"api_base": "https://a", "api_key": "k1"}, {"api_base": "https://b", "api_key": "k2"}]',
+        "BACKOFF_MULTIPLIER": "2.5",
+        "FIRST_CHUNK_TIMEOUT_MILLIS": "1234",
+        "PORT": "8080",
+        "EMBEDDER_MODEL": "bge-small-en",
+        "MESH_DP": "4",
+    }
+    c = Config.from_env(env)
+    assert [a.api_base for a in c.api_bases()] == ["https://a", "https://b"]
+    assert c.backoff_policy().multiplier == 2.5
+    assert c.first_chunk_timeout_millis == 1234
+    assert c.port == 8080
+    assert c.embedder_model == "bge-small-en"
+    assert c.mesh_dp == 4
+    # defaults (main.rs:5-20)
+    assert c.backoff_policy().initial_interval_ms == 100
+    assert c.other_chunk_timeout_millis == 60000
+
+
+def test_config_single_api_base_fallback():
+    c = Config.from_env({"OPENAI_API_BASE": "https://x", "OPENAI_API_KEY": "s"})
+    assert [a.api_key for a in c.api_bases()] == ["s"]
+    assert Config.from_env({}).openai_apis == []

@@ -1,0 +1,104 @@
+"""TPU trained-weights path: embedding lookup -> per-judge weights within
+[min, max] bounds; evidence echo + usage seeding (SURVEY §2.1 weight seam)."""
+
+import asyncio
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from llm_weighted_consensus_tpu.identity.model import ModelBase
+from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+from llm_weighted_consensus_tpu.types.score_request import (
+    ChatCompletionCreateParams as ScoreParams,
+)
+from llm_weighted_consensus_tpu.types.score_response import TrainingTableData
+from llm_weighted_consensus_tpu.weights.training_table import (
+    TpuTrainingTableFetcher,
+    TrainingTableStore,
+)
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def tt_model(n_judges=2):
+    return ModelBase.from_json_obj(
+        {
+            "llms": [
+                {
+                    "model": f"judge-{i}",
+                    "weight": {
+                        "type": "training_table",
+                        "base_weight": 1,
+                        "min_weight": 1,
+                        "max_weight": 5,
+                    },
+                }
+                for i in range(n_judges)
+            ],
+            "weight": {
+                "type": "training_table",
+                "embeddings": {"model": "test-tiny", "max_tokens": 32},
+                "top": 3,
+            },
+        }
+    ).into_model_validate()
+
+
+def params(text="what is the answer?"):
+    return ScoreParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": text}],
+            "model": "x" * 22,
+            "choices": ["a", "b"],
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def embedder():
+    return TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=32, seed=5)
+
+
+def test_fallback_to_base_weight_without_table(embedder):
+    model = tt_model()
+    fetcher = TpuTrainingTableFetcher(embedder)
+    weights, data = go(fetcher.fetch(None, params(), model))
+    assert weights == [Decimal(1), Decimal(1)]
+    assert isinstance(data, TrainingTableData)
+    assert data.embeddings_response.usage.total_tokens > 0
+    assert len(data.embeddings_response.data) == 1
+
+
+def test_table_lookup_discriminates_judges(embedder):
+    model = tt_model()
+    store = TrainingTableStore()
+    prompt = "what is the answer?"
+    query_vec = embedder.embed_texts([prompt])[0]
+    rng = np.random.default_rng(0)
+    near = np.stack([query_vec + 0.001 * rng.normal(size=query_vec.shape) for _ in range(5)])
+    # judge 0: historically perfect on similar prompts; judge 1: terrible
+    good, bad = model.llms[0], model.llms[1]
+    store.add_rows(good.training_table_id, near, np.ones(5))
+    store.add_rows(bad.training_table_id, near, np.zeros(5))
+    fetcher = TpuTrainingTableFetcher(embedder, store)
+    weights, _ = go(fetcher.fetch(None, params(prompt), model))
+    w = {llm.index: weights[llm.index] for llm in model.llms}
+    assert float(w[good.index]) == pytest.approx(5.0, abs=0.3)
+    assert float(w[bad.index]) == pytest.approx(1.0, abs=0.3)
+    # bounds respected
+    assert all(Decimal(1) <= x <= Decimal(5) for x in weights)
+
+
+def test_store_appends_rows():
+    store = TrainingTableStore()
+    store.add_rows("t1", np.ones((2, 4)), np.ones(2))
+    store.add_rows("t1", np.zeros((3, 4)), np.zeros(3))
+    emb, scores = store.get("t1")
+    assert emb.shape == (5, 4) and scores.shape == (5,)
+    assert store.get("missing") is None

@@ -35,7 +35,6 @@ from ..types.score_response import CompletionMetadata
 from ..utils import response_id
 from .chat import ChatClient, _try_join
 from .score import (
-    ScoreError,
     fetch_archived_for_choices_and_messages,
     fetch_or_validate_score_model,
     merge_streams,
@@ -73,9 +72,9 @@ class MultichatClient:
         stream = await self.create_streaming(ctx, params)
         chunks = []
         try:
+            # slot streams convert every failure into error choices, so the
+            # stream yields only chunks (unlike score's AllVotesFailed item)
             async for item in stream:
-                if isinstance(item, ScoreError):
-                    raise item
                 chunks.append(item)
         finally:
             await stream.aclose()

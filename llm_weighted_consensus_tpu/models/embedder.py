@@ -10,6 +10,7 @@ seeds ``weight_data`` cost, score client.rs:330-337).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Iterable, Optional
 
 import jax
@@ -21,6 +22,19 @@ from ..types.embeddings import CreateEmbeddingResponse, Embedding
 from . import bert
 from .configs import PRESETS, BertConfig
 from .tokenizer import BaseTokenizer, load_tokenizer
+
+
+@partial(
+    jax.jit, static_argnames=("config", "pooling", "temperature")
+)
+def _embed_and_vote(params, ids, mask, config, pooling, temperature):
+    """Single-dispatch self-consistency: encoder forward + cosine consensus
+    vote fused under one jit so nothing round-trips the host between them
+    (the serving hot path: one upload, one tiny download)."""
+    from ..ops.similarity import cosine_consensus_vote
+
+    emb = bert.embed(params, ids, mask, config, pooling=pooling)
+    return cosine_consensus_vote(emb, temperature=temperature)
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -115,6 +129,26 @@ class TpuEmbedder:
             normalize=True,
         )
         return np.asarray(emb[:b])
+
+    def consensus_confidence(
+        self,
+        texts: list,
+        max_tokens: Optional[int] = None,
+        temperature: float = 0.05,
+    ) -> np.ndarray:
+        """texts (N candidates) -> confidence[N]: the whole embed + cosine
+        self-consistency vote in ONE device dispatch."""
+        ids, mask = self.tokenize(texts, max_tokens)
+        return self.consensus_confidence_tokens(ids, mask, temperature)
+
+    def consensus_confidence_tokens(
+        self, ids: np.ndarray, mask: np.ndarray, temperature: float = 0.05
+    ):
+        dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
+        return _embed_and_vote(
+            self.params, dev_ids, dev_mask, self.config, self.pooling,
+            temperature,
+        )
 
     def token_count(self, texts: list, max_tokens: Optional[int] = None) -> int:
         _, mask = self.tokenize(texts, max_tokens)

@@ -28,7 +28,7 @@ FAKE_PORT = 5990
 
 async def _fake_upstream(request: web.Request) -> web.StreamResponse:
     """A scripted judge provider: finds the ballot in the system prompt and
-    votes for the first key; plain chat otherwise."""
+    votes for a random key; plain chat otherwise."""
     body = await request.json()
     content = "This is a fake upstream completion."
     for message in reversed(body.get("messages", [])):
@@ -104,7 +104,13 @@ def build_service(config: Config, fake_upstream: bool = False):
 
         embedder = TpuEmbedder(
             config.embedder_model,
-            tokenizer=load_tokenizer(config.embedder_vocab),
+            # only override the tokenizer when a real vocab is configured;
+            # TpuEmbedder's default hash fallback sizes to the model vocab
+            tokenizer=(
+                load_tokenizer(config.embedder_vocab)
+                if config.embedder_vocab
+                else None
+            ),
             max_tokens=config.embedder_max_tokens,
         )
         weight_fetchers = WeightFetchers(

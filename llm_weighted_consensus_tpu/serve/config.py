@@ -26,9 +26,12 @@ def load_dotenv(path: str = ".env") -> None:
                 line = line[len("export "):]
             key, _, value = line.partition("=")
             value = value.strip()
-            # dotenv-style quoted values
+            # dotenv-style quoted values; unquoted values drop trailing
+            # inline comments
             if len(value) >= 2 and value[0] == value[-1] and value[0] in "'\"":
                 value = value[1:-1]
+            elif " #" in value:
+                value = value.split(" #", 1)[0].rstrip()
             os.environ.setdefault(key.strip(), value)
 
 

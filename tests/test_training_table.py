@@ -102,3 +102,99 @@ def test_store_appends_rows():
     emb, scores = store.get("t1")
     assert emb.shape == (5, 4) and scores.shape == (5,)
     assert store.get("missing") is None
+
+
+# -- archive batch re-score (BASELINE config 4) -------------------------------
+
+
+def test_archive_rescore_reweighting():
+    import random
+
+    from llm_weighted_consensus_tpu import archive, registry
+    from llm_weighted_consensus_tpu.archive.rescore import (
+        apply_rescore,
+        rescore_archive,
+        vote_matrix,
+    )
+    from llm_weighted_consensus_tpu.ballot import PrefixTree
+    from llm_weighted_consensus_tpu.clients.chat import (
+        ApiBase,
+        BackoffPolicy,
+        DefaultChatClient,
+    )
+    from llm_weighted_consensus_tpu.clients.score import ScoreClient
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from fakes import FakeTransport, Script, chunk_obj
+
+    SEED = 13
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, 2, 20)
+    keys = {idx: k for k, idx in tree.key_indices(rng)}
+
+    model = ModelBase.from_json_obj(
+        {
+            "llms": [
+                {"model": "j-a", "weight": {"type": "static", "weight": 1}},
+                {"model": "j-b", "weight": {"type": "static", "weight": 1}},
+            ]
+        }
+    ).into_model_validate()
+    order = [llm.base.model for llm in model.llms]
+    by_model = {
+        "j-a": Script([chunk_obj(f"pick {keys[0]}", model="j-a", finish="stop")]),
+        "j-b": Script([chunk_obj(f"pick {keys[1]}", model="j-b", finish="stop")]),
+    }
+    transport = FakeTransport([by_model[m] for m in order])
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")],
+        backoff=BackoffPolicy(max_elapsed_ms=0),
+    )
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat, registry.InMemoryModelRegistry(), archive_fetcher=store,
+        rng_factory=lambda: random.Random(SEED),
+    )
+    result = go(
+        score.create_unary(
+            None,
+            ScoreParams.from_json_obj(
+                {
+                    "messages": [{"role": "user", "content": "q"}],
+                    "model": {"llms": [llm.base.to_json_obj() for llm in model.llms]},
+                    "choices": ["a", "b"],
+                }
+            ),
+        )
+    )
+    store.put_score(result)
+
+    # stored tie: 0.5 / 0.5
+    votes, weights, mask = vote_matrix(result)
+    assert votes.shape == (2, 2) and mask.tolist() == [1.0, 1.0]
+    cand = {c.index: c for c in result.choices if c.index < 2}
+    assert float(cand[0].confidence) == pytest.approx(0.5)
+
+    # reweight judge a 3:1 and re-tally on device - no upstream requests
+    a_id = next(llm.id for llm in model.llms if llm.base.model == "j-a")
+    results = rescore_archive(store, weight_overrides={a_id: 3.0})
+    conf = [float(x) for x in results[result.id]["confidence"]]
+    assert conf[0] == pytest.approx(0.75) and conf[1] == pytest.approx(0.25)
+    assert apply_rescore(store, results) == 1
+    assert float(cand[0].confidence) == pytest.approx(0.75)
+
+
+def test_archive_rescore_mesh_10k_shape():
+    """config-4 shape: thousands of archived vote matrices, one mesh batch."""
+    from llm_weighted_consensus_tpu.parallel import make_mesh
+    from llm_weighted_consensus_tpu.parallel.batch import rescore_batch
+
+    rng = np.random.default_rng(0)
+    b, m, n = 2048, 8, 8
+    votes = rng.random((b, m, n)).astype(np.float32)
+    votes /= votes.sum(axis=2, keepdims=True)
+    weights = rng.uniform(0.5, 2.0, (b, m)).astype(np.float32)
+    mesh = make_mesh(dp=8, tp=1)
+    _, conf = rescore_batch(votes, weights, mesh=mesh)
+    assert conf.shape == (b, n)
+    np.testing.assert_allclose(np.asarray(conf).sum(axis=1), 1.0, atol=1e-5)

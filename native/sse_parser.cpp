@@ -1,0 +1,126 @@
+// Incremental server-sent-events parser — the native twin of
+// clients/sse.py (hot loop #1 of the serving path, SURVEY §3.5: per-token
+// work on every judge stream).
+//
+// The reference's native runtime handles this loop in Rust
+// (reqwest-eventsource inside chat/completions/client.rs:334-434); this is
+// the C++ equivalent for the TPU framework's gateway, exposed through a
+// minimal C ABI consumed via ctypes (no pybind11 in the image).
+//
+// Frame semantics match the Python parser exactly (tests/test_native.py
+// runs both against the same corpus): `data:` lines accumulate per event
+// (joined by '\n'), a blank line dispatches, ':' comments and other fields
+// are ignored, LF and CRLF both accepted.
+//
+// C ABI:
+//   sse_parser_new()                       -> opaque handle
+//   sse_parser_feed(h, buf, len)           -> number of completed events
+//   sse_parser_next_event(h, &len)         -> pointer to next event bytes
+//                                             (UTF-8, valid until the next
+//                                             feed/flush/free call)
+//   sse_parser_flush(h)                    -> trailing unterminated event
+//   sse_parser_free(h)
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Parser {
+  std::string buffer;        // undecoded bytes
+  std::string data;          // accumulated data lines for the open event
+  bool has_data = false;
+  std::deque<std::string> events;  // completed, not yet consumed
+  std::string scratch;       // storage for the last returned event
+
+  void feed_line(const char* line, size_t len) {
+    // strip trailing CR (CRLF endings)
+    if (len > 0 && line[len - 1] == '\r') --len;
+    if (len == 0) {  // blank line: dispatch
+      if (has_data) {
+        events.emplace_back(std::move(data));
+        data.clear();
+        has_data = false;
+      }
+      return;
+    }
+    if (line[0] == ':') return;  // comment
+    const char* colon = static_cast<const char*>(memchr(line, ':', len));
+    size_t field_len = colon ? static_cast<size_t>(colon - line) : len;
+    if (field_len != 4 || memcmp(line, "data", 4) != 0) return;
+    const char* value = colon ? colon + 1 : line + len;
+    size_t value_len = colon ? len - field_len - 1 : 0;
+    if (value_len > 0 && value[0] == ' ') {
+      ++value;
+      --value_len;
+    }
+    if (has_data) data.push_back('\n');
+    data.append(value, value_len);
+    has_data = true;
+  }
+
+  size_t feed(const char* bytes, size_t len) {
+    buffer.append(bytes, len);
+    size_t start = 0;
+    for (;;) {
+      const char* nl = static_cast<const char*>(
+          memchr(buffer.data() + start, '\n', buffer.size() - start));
+      if (!nl) break;
+      size_t line_end = static_cast<size_t>(nl - buffer.data());
+      feed_line(buffer.data() + start, line_end - start);
+      start = line_end + 1;
+    }
+    if (start > 0) buffer.erase(0, start);
+    return events.size();
+  }
+
+  bool flush() {
+    if (!has_data) return false;
+    events.emplace_back(std::move(data));
+    data.clear();
+    has_data = false;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sse_parser_new() { return new Parser(); }
+
+void sse_parser_free(void* handle) { delete static_cast<Parser*>(handle); }
+
+// Returns the number of completed events ready to consume.
+size_t sse_parser_feed(void* handle, const uint8_t* buf, size_t len) {
+  auto* p = static_cast<Parser*>(handle);
+  p->feed(reinterpret_cast<const char*>(buf), len);
+  return p->events.size();
+}
+
+// Pops the next completed event; returns nullptr when none remain.  The
+// pointer stays valid until the next call into the parser.
+const uint8_t* sse_parser_next_event(void* handle, size_t* out_len) {
+  auto* p = static_cast<Parser*>(handle);
+  if (p->events.empty()) {
+    *out_len = 0;
+    return nullptr;
+  }
+  p->scratch = std::move(p->events.front());
+  p->events.pop_front();
+  *out_len = p->scratch.size();
+  return reinterpret_cast<const uint8_t*>(p->scratch.data());
+}
+
+// Dispatches any trailing unterminated event; returns completed count.
+size_t sse_parser_flush(void* handle) {
+  auto* p = static_cast<Parser*>(handle);
+  p->flush();
+  return p->events.size();
+}
+
+}  // extern "C"

@@ -6,16 +6,16 @@ initializes its backends, hence here, before any test module imports jax.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Force CPU even when the ambient environment points JAX at real hardware
 # (e.g. JAX_PLATFORMS=axon, the single-chip TPU tunnel): tests exercise the
 # virtual 8-device mesh; bench.py is what runs on the real chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from __graft_entry__ import _apply_virtual_cpu_env  # noqa: E402
+
+_apply_virtual_cpu_env(8)
 
 # The environment may pre-import jax pointed at real hardware (sitecustomize
 # in PYTHONPATH); the config update below wins as long as no computation has

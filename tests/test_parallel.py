@@ -170,3 +170,12 @@ def test_graft_entry_points():
     assert out.shape == (8,)
     assert float(jnp.sum(out)) == pytest.approx(1.0, abs=1e-5)
     ge.dryrun_multichip(8)
+
+
+def test_graft_dryrun_subprocess_fallback():
+    """n_devices above the live device count must re-exec in a virtual-CPU
+    subprocess (the driver's bench machine has a single TPU chip)."""
+    import __graft_entry__ as ge
+
+    assert len(jax.devices()) < 16
+    ge.dryrun_multichip(16)

@@ -1,22 +1,37 @@
 #!/usr/bin/env python
 """Headline benchmark: consensus answers/sec + p50 latency, N=64, bge-large.
 
-The BASELINE.json metric ("consensus answers/sec + p50 latency at N=64
-candidates, bge-large"): one *answer* = one full self-consistency consensus —
-tokenize 64 candidate texts on host, embed them with a bge-large encoder on
-device (bf16), and produce the fused cosine consensus vote.  The north-star
-targets are p50 < 200 ms end-to-end and >=10x a candle-CUDA A100 pipeline;
-the reference publishes no numbers (SURVEY §6), so ``vs_baseline`` is
-reported against the target rate implied by the p50 budget: 1000/200ms =
-5 answers/sec.  vs_baseline > 1.0 means the p50 target is beaten on
-sustained throughput.
+One *answer* = one full self-consistency consensus: tokenize 64 candidate
+texts on host, embed them with a bge-large encoder on device (bf16, padded
+to a fixed seq=128), and produce the fused cosine consensus vote
+(BASELINE.json metric).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": answers/sec, "unit": "answers/sec",
-   "vs_baseline": value/5.0, "p50_ms": ..., "p99_ms": ..., ...}
+Honesty rules (VERDICT r1 item 3):
+* tokenization + host->device upload + result fetch are all inside the
+  timed path — nothing is pre-staged;
+* >=100 throughput requests after an explicit warm-up; p50/p99 from >=50
+  serial end-to-end requests;
+* ``vs_baseline`` compares against a *documented estimate* of the
+  candle-CUDA A100 pipeline the targets reference (BASELINE.md): A100 SXM
+  bf16 dense peak is 312 TFLOP/s; a well-tuned candle bge-large forward at
+  40% MFU sustains ~125 TFLOP/s; one N=64/seq=128 answer costs ~5.06
+  TFLOP, giving ~25 answers/sec.  The A100 itself is unmeasurable in this
+  image (no CUDA hardware), so the estimate is stated, not measured, and
+  the raw roofline numbers (device-only ms, effective TFLOP/s, MFU vs the
+  197 TFLOP/s v5e bf16 peak) are reported alongside.
 
-Flags: --model (default bge-large-en), --n (64), --seq (128), --requests,
---pipeline (overlap host tokenization with device compute, default on).
+Throughput uses the serving pipeline shape: dispatches are async (host
+tokenizes request i+1 while the device runs request i) and result fetches
+overlap on a small thread pool — exactly what the asyncio gateway does
+with its executor.  Latency is strictly serial.  On this environment the
+device link is a tunnel with ~100 ms round-trip latency; per-request p50
+is RTT-bound (the device-only forward is ~30 ms), which the ``rtt_ms``
+field makes explicit.
+
+Prints ONE JSON line.
+
+Flags: --model (default bge-large-en), --n (64), --seq (128),
+--requests (100), --latency-requests (50), --no-pipeline.
 """
 
 from __future__ import annotations
@@ -26,10 +41,23 @@ import json
 import statistics
 import sys
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-TARGET_ANSWERS_PER_SEC = 5.0  # 1000 ms / 200 ms p50 budget
+# Documented candle-CUDA A100 estimate (see module docstring): 312 TFLOP/s
+# peak * 0.40 MFU / 5.06 TFLOP per answer ~= 25 answers/sec.
+BASELINE_A100_ANSWERS_PER_SEC = 25.0
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+def flops_per_answer(config, n: int, s: int) -> float:
+    """Dense + attention matmul FLOPs for one N-candidate forward."""
+    h, i = config.hidden_size, config.intermediate_size
+    tokens = n * s
+    dense = 2 * (4 * h * h + 2 * h * i)
+    attn = 4 * s * h
+    return float(config.num_layers * (dense + attn) * tokens)
 
 
 def make_requests(n_requests: int, n_candidates: int, seed: int = 0) -> list:
@@ -42,10 +70,65 @@ def make_requests(n_requests: int, n_candidates: int, seed: int = 0) -> list:
     for r in range(n_requests):
         texts = []
         for i in range(n_candidates):
-            words = rng.choice(vocab, size=24).tolist() + [f"v{r}", f"c{i}"]
+            words = rng.choice(vocab, size=96).tolist() + [f"v{r}", f"c{i}"]
             texts.append(" ".join(words))
         requests.append(texts)
     return requests
+
+
+def tokenize_fixed(embedder, texts: list, seq: int):
+    """Tokenize to the exact benchmark shape [N, seq] (no bucket shrink —
+    the metric is defined at seq=128)."""
+    ids, mask = embedder.tokenizer.encode_batch(texts, seq)
+    return ids, mask
+
+
+def measure_rtt_ms(reps: int = 10) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    g = jax.jit(lambda x: jnp.sum(x))
+    x = jnp.ones((8, 8))
+    float(g(x))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        float(g(x))
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def measure_device_only_ms(embedder, ids, mask, temperature=0.05) -> float:
+    """Amortized on-device time for one forward+vote, excluding the host
+    link: run the body k times inside one dispatch (inputs varied per
+    iteration so XLA cannot hoist) and difference k=1 vs k=21."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from llm_weighted_consensus_tpu.models import bert
+    from llm_weighted_consensus_tpu.ops.kernels import fused_cosine_vote
+
+    config = embedder.config
+
+    @partial(jax.jit, static_argnames=("k",))
+    def rep(params, ids, mask, k):
+        def body(i, acc):
+            ids_i = (ids + i) % config.vocab_size
+            emb = bert.embed(
+                params, ids_i, mask, config, pooling=embedder.pooling
+            )
+            return acc + jnp.sum(fused_cosine_vote(emb, temperature=temperature))
+        return jax.lax.fori_loop(0, k, body, 0.0)
+
+    dev_ids, dev_mask = jnp.asarray(ids), jnp.asarray(mask)
+    float(rep(embedder.params, dev_ids, dev_mask, 1))
+    float(rep(embedder.params, dev_ids, dev_mask, 21))
+    t0 = time.perf_counter()
+    float(rep(embedder.params, dev_ids, dev_mask, 1))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(rep(embedder.params, dev_ids, dev_mask, 21))
+    t21 = time.perf_counter() - t0
+    return max((t21 - t1) / 20 * 1e3, 1e-3)
 
 
 def main() -> int:
@@ -53,7 +136,8 @@ def main() -> int:
     parser.add_argument("--model", default="bge-large-en")
     parser.add_argument("--n", type=int, default=64)
     parser.add_argument("--seq", type=int, default=128)
-    parser.add_argument("--requests", type=int, default=30)
+    parser.add_argument("--requests", type=int, default=100)
+    parser.add_argument("--latency-requests", type=int, default=50)
     parser.add_argument("--no-pipeline", action="store_true")
     args = parser.parse_args()
 
@@ -68,57 +152,78 @@ def main() -> int:
     embedder = TpuEmbedder(args.model, max_tokens=args.seq, dtype=dtype)
     requests = make_requests(args.requests, args.n)
 
-    # host-side tokenization up front (in serving this overlaps device work)
-    tokenized = [embedder.tokenize(texts) for texts in requests]
-    # same bucketed shape for every request -> one compile
-    tokenized = [
-        (ids[:, : args.seq], mask[:, : args.seq]) for ids, mask in tokenized
-    ]
-
-    def consensus(ids, mask):
-        # ONE device dispatch: encoder forward + cosine vote fused
+    def consensus(texts):
+        ids, mask = tokenize_fixed(embedder, texts, args.seq)
         return embedder.consensus_confidence_tokens(ids, mask)
 
-    # warm-up: compile
-    warm = np.asarray(consensus(*tokenized[0]))
+    # warm-up: compile + steady-state (first tunnel calls are slower)
+    for w in range(3):
+        warm = np.asarray(consensus(requests[w % len(requests)]))
     np.testing.assert_allclose(float(warm.sum()), 1.0, atol=1e-3)
 
-    # p50: per-request latency with honest result fetch
+    # latency: strictly serial end-to-end (tokenize -> upload -> forward ->
+    # fetch), one request at a time
     latencies = []
-    for ids, mask in tokenized:
+    for texts in requests[: args.latency_requests]:
         t0 = time.perf_counter()
-        _ = np.asarray(consensus(ids, mask))
+        _ = np.asarray(consensus(texts))
         latencies.append((time.perf_counter() - t0) * 1000.0)
 
-    # throughput: K requests in flight (async dispatch pipeline)
-    in_flight = 1 if args.no_pipeline else 4
-    pending = []
+    # throughput: async dispatch + overlapped fetches (the serving shape);
+    # --no-pipeline is the strictly-serial baseline (fetch before the next
+    # dispatch, nothing overlapped)
     t_start = time.perf_counter()
-    for ids, mask in tokenized:
-        pending.append(consensus(ids, mask))
-        if len(pending) > in_flight:
-            np.asarray(pending.pop(0))
-    for out in pending:
-        np.asarray(out)
+    if args.no_pipeline:
+        results = [np.asarray(consensus(texts)) for texts in requests]
+    else:
+        fetch_pool = ThreadPoolExecutor(8)
+        futures = []
+        for texts in requests:
+            out = consensus(texts)  # tokenize (host) + async dispatch
+            futures.append(fetch_pool.submit(np.asarray, out))
+            while sum(not f.done() for f in futures) > 32:
+                time.sleep(0.001)
+        results = [f.result() for f in futures]
+        fetch_pool.shutdown()
     total = time.perf_counter() - t_start
+    for r in results:
+        assert abs(float(np.sum(r)) - 1.0) < 1e-2
 
-    answers_per_sec = len(tokenized) / total
+    answers_per_sec = len(requests) / total
     p50 = statistics.median(latencies)
-    p99 = sorted(latencies)[max(0, int(len(latencies) * 0.99) - 1)]
+    ordered = sorted(latencies)
+    p99 = ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+
+    ids0, mask0 = tokenize_fixed(embedder, requests[0], args.seq)
+    device_ms = measure_device_only_ms(embedder, ids0, mask0)
+    rtt_ms = measure_rtt_ms()
+    tflops = flops_per_answer(embedder.config, args.n, args.seq) / 1e12
+    eff_tflops = tflops / (device_ms / 1e3)
 
     print(
         json.dumps(
             {
-                "metric": "consensus answers/sec + p50 latency at N=64 candidates, bge-large",
+                "metric": (
+                    f"consensus answers/sec + p50 latency at N={args.n} "
+                    f"candidates, {args.model}"
+                ),
                 "value": round(answers_per_sec, 3),
                 "unit": "answers/sec",
-                "vs_baseline": round(answers_per_sec / TARGET_ANSWERS_PER_SEC, 3),
+                "vs_baseline": round(
+                    answers_per_sec / BASELINE_A100_ANSWERS_PER_SEC, 3
+                ),
+                "baseline": "estimated candle-CUDA A100 rate: 25 answers/sec (312 TFLOP/s peak x 40% MFU / 5.06 TFLOP per answer); unmeasurable here, see bench.py docstring",
                 "p50_ms": round(p50, 2),
                 "p99_ms": round(p99, 2),
+                "device_only_ms": round(device_ms, 2),
+                "link_rtt_ms": round(rtt_ms, 1),
+                "effective_tflops": round(eff_tflops, 1),
+                "mfu_vs_v5e_peak": round(eff_tflops / V5E_BF16_PEAK_TFLOPS, 3),
                 "n_candidates": args.n,
+                "seq": args.seq,
                 "model": args.model,
                 "backend": backend,
-                "requests": len(tokenized),
+                "requests": len(requests),
             }
         )
     )

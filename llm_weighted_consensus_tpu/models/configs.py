@@ -16,6 +16,9 @@ class BertConfig:
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
     pad_token_id: int = 0
+    # "auto" routes to the fused Pallas attention kernel on TPU when the
+    # (s, s) tile fits VMEM; "fused" / "einsum" force one path.
+    attention_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:

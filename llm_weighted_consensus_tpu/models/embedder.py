@@ -30,11 +30,39 @@ from .tokenizer import BaseTokenizer, load_tokenizer
 def _embed_and_vote(params, ids, mask, config, pooling, temperature):
     """Single-dispatch self-consistency: encoder forward + cosine consensus
     vote fused under one jit so nothing round-trips the host between them
-    (the serving hot path: one upload, one tiny download)."""
-    from ..ops.similarity import cosine_consensus_vote
+    (the serving hot path: one upload, one tiny download).  The vote runs
+    in the fused Pallas kernel (VMEM-resident normalize+cosine+softmax);
+    ``fused_cosine_vote`` itself falls back to the jnp composition beyond
+    its single-block budget."""
+    from ..ops.kernels import fused_cosine_vote
 
     emb = bert.embed(params, ids, mask, config, pooling=pooling)
-    return cosine_consensus_vote(emb, temperature=temperature)
+    with jax.named_scope("consensus_vote"):
+        return fused_cosine_vote(emb, temperature=temperature)
+
+
+@partial(
+    jax.jit, static_argnames=("r", "config", "pooling", "temperature")
+)
+def _embed_and_vote_many(params, ids, mask, r, config, pooling, temperature):
+    """Batched self-consistency: ids/mask[R*N, S] -> confidence[R, N].
+
+    R concurrent requests share ONE device dispatch (dynamic batching —
+    the encoder sees one [R*N, S] batch), amortizing the host<->device
+    round-trip that dominates single-request latency on tunneled links.
+    Scoring uses the same fused kernel as the single-request path (one
+    scorer implementation; R is small so the unrolled loop is cheap)."""
+    from ..ops.kernels import fused_cosine_vote
+
+    emb = bert.embed(params, ids, mask, config, pooling=pooling)
+    emb = emb.reshape(r, emb.shape[0] // r, -1)
+    with jax.named_scope("consensus_vote_many"):
+        return jnp.stack(
+            [
+                fused_cosine_vote(emb[i], temperature=temperature)
+                for i in range(r)
+            ]
+        )
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -147,6 +175,21 @@ class TpuEmbedder:
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
         return _embed_and_vote(
             self.params, dev_ids, dev_mask, self.config, self.pooling,
+            temperature,
+        )
+
+    def consensus_confidence_tokens_many(
+        self, ids: np.ndarray, mask: np.ndarray, temperature: float = 0.05
+    ):
+        """ids/mask[R, N, S] (R concurrent requests) -> confidence[R, N] in
+        ONE device dispatch (dynamic batching for the serving loop)."""
+        r, n, s = ids.shape
+        dev_ids, dev_mask = self.put_batch(
+            jnp.asarray(ids.reshape(r * n, s)),
+            jnp.asarray(mask.reshape(r * n, s)),
+        )
+        return _embed_and_vote_many(
+            self.params, dev_ids, dev_mask, r, self.config, self.pooling,
             temperature,
         )
 

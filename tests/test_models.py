@@ -216,3 +216,48 @@ def test_reward_consensus_vote(rm_params):
     conf = np.asarray(deberta.reward_consensus_vote(rewards))
     assert conf.sum() == pytest.approx(1.0, abs=1e-6)
     assert conf[0] > conf[1] > conf[2]
+
+
+# -- fused attention (ops/attention.py) ---------------------------------------
+
+
+def test_fused_attention_matches_einsum(params):
+    from dataclasses import replace
+
+    ids, mask = toks(4, 24, n_pad=7)
+    cfg_e = replace(TINY, attention_impl="einsum")
+    cfg_f = replace(TINY, attention_impl="fused")
+    e1 = bert.embed(params, ids, mask, cfg_e)
+    e2 = bert.embed(params, ids, mask, cfg_f)
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=2e-5)
+
+
+def test_fused_attention_padding_invariance(params):
+    from dataclasses import replace
+
+    cfg_f = replace(TINY, attention_impl="fused")
+    ids, mask = toks(2, 16, n_pad=5)
+    e1 = bert.embed(params, ids, mask, cfg_f)
+    # extending padding must not change the embedding of real tokens
+    ids2 = jnp.pad(ids, ((0, 0), (0, 8)))
+    mask2 = jnp.pad(mask, ((0, 0), (0, 8)))
+    e2 = bert.embed(params, ids2, mask2, cfg_f)
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=2e-5)
+
+
+def test_embed_and_vote_many_matches_single():
+    emb = TpuEmbedder("test-tiny")
+    rng = np.random.default_rng(3)
+    reqs = []
+    for r in range(3):
+        ids = rng.integers(3, TINY.vocab_size, size=(4, 16)).astype(np.int32)
+        mask = np.ones((4, 16), dtype=np.int32)
+        reqs.append((ids, mask))
+    batched = emb.consensus_confidence_tokens_many(
+        np.stack([r[0] for r in reqs]), np.stack([r[1] for r in reqs])
+    )
+    batched = np.asarray(batched)
+    assert batched.shape == (3, 4)
+    for i, (ids, mask) in enumerate(reqs):
+        single = np.asarray(emb.consensus_confidence_tokens(ids, mask))
+        np.testing.assert_allclose(batched[i], single, atol=1e-5)

@@ -1,0 +1,350 @@
+#!/usr/bin/env python
+"""All five BASELINE.md benchmark configs, one JSON line each.
+
+1. N=8 single-model self-consistency, bge-small-en cosine vote
+2. N=32 multichat (3 backends) weighted consensus, bge-large-en
+3. Reward-model re-ranking (deberta-v3 RM) replacing cosine vote
+4. Archive batch re-score (10k archived candidates, one device batch)
+5. Streaming multichat with incremental on-device consensus update
+
+Configs 2 and 5 run the real async multichat client over the scripted
+fake-provider harness (tests/fakes.py) — upstream generation is instant,
+so the numbers measure THIS framework's fan-out + device consensus, not a
+provider.  Headline config (N=64 bge-large) lives in bench.py.
+
+Run: python bench_all.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+
+from bench import flops_per_answer, make_requests, tokenize_fixed  # noqa: E402
+
+
+def emit(config: int, metric: str, value: float, unit: str, **extra) -> None:
+    print(
+        json.dumps(
+            {
+                "config": config,
+                "metric": metric,
+                "value": round(value, 3),
+                "unit": unit,
+                **extra,
+            }
+        ),
+        flush=True,
+    )
+
+
+def bench_self_consistency(
+    model: str, n: int, seq: int, requests: int, config_num: int
+) -> None:
+    """Configs 1 (bge-small N=8): the bench.py harness at other shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    embedder = TpuEmbedder(model, max_tokens=seq, dtype=dtype)
+    reqs = make_requests(requests, n)
+
+    def consensus(texts):
+        ids, mask = tokenize_fixed(embedder, texts, seq)
+        return embedder.consensus_confidence_tokens(ids, mask)
+
+    for w in range(3):
+        np.asarray(consensus(reqs[w % len(reqs)]))
+    latencies = []
+    for texts in reqs[: min(20, len(reqs))]:
+        t0 = time.perf_counter()
+        np.asarray(consensus(texts))
+        latencies.append((time.perf_counter() - t0) * 1e3)
+    pool = ThreadPoolExecutor(8)
+    t0 = time.perf_counter()
+    futs = [pool.submit(np.asarray, consensus(texts)) for texts in reqs]
+    for f in futs:
+        f.result()
+    total = time.perf_counter() - t0
+    pool.shutdown()
+    emit(
+        config_num,
+        f"self-consistency answers/sec, N={n}, {model}",
+        len(reqs) / total,
+        "answers/sec",
+        p50_ms=round(statistics.median(latencies), 2),
+        requests=len(reqs),
+    )
+
+
+def _make_panel(n_slots: int, backends: int):
+    from llm_weighted_consensus_tpu.identity.model import ModelBase
+
+    return ModelBase.from_json_obj(
+        {
+            "llms": [
+                {
+                    "model": f"backend-{i % backends}",
+                    "weight": {"type": "static", "weight": 1 + i % 3},
+                }
+                for i in range(n_slots)
+            ]
+        }
+    ).into_model_validate()
+
+
+def _multichat_client(scripts):
+    from fakes import FakeTransport
+
+    from llm_weighted_consensus_tpu.clients.chat import (
+        ApiBase,
+        BackoffPolicy,
+        DefaultChatClient,
+    )
+    from llm_weighted_consensus_tpu.clients.multichat import MultichatClient
+    from llm_weighted_consensus_tpu import registry
+
+    chat = DefaultChatClient(
+        FakeTransport(scripts),
+        [ApiBase("https://up.example", "k")],
+        backoff=BackoffPolicy(max_elapsed_ms=0),
+    )
+    return MultichatClient(chat, registry.InMemoryModelRegistry())
+
+
+def bench_multichat_weighted(n: int, backends: int, requests: int) -> None:
+    """Config 2: multichat fan-out -> device cosine vote x generator
+    weights -> normalized weighted consensus."""
+    import jax
+    import jax.numpy as jnp
+
+    from fakes import Script, chunk_obj
+
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+    from llm_weighted_consensus_tpu.types.multichat_request import (
+        ChatCompletionCreateParams,
+    )
+
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    embedder = TpuEmbedder("bge-large-en", max_tokens=128, dtype=dtype)
+    model = _make_panel(n, backends)
+    params = ChatCompletionCreateParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "solve it"}],
+            "model": {"llms": [llm.base.to_json_obj() for llm in model.llms]},
+        }
+    )
+    weights = np.array(
+        [float(llm.base.weight.weight) for llm in model.llms],
+        dtype=np.float32,
+    )
+
+    def scripts(r):
+        return [
+            Script(
+                [
+                    chunk_obj(
+                        f"candidate {r} answer {i % 4} from slot {i}",
+                        finish="stop",
+                    )
+                ]
+            )
+            for i in range(n)
+        ]
+
+    async def one(r):
+        client = _multichat_client(scripts(r))
+        mc = await client.create_unary(None, params)
+        texts = [c.message.content or "" for c in mc.choices]
+        ids, mask = tokenize_fixed(embedder, texts, 128)
+        vote = np.asarray(embedder.consensus_confidence_tokens(ids, mask))
+        weighted = vote * weights[: len(vote)]
+        return weighted / weighted.sum()
+
+    conf = asyncio.new_event_loop().run_until_complete(one(0))  # warm-up
+    assert abs(conf.sum() - 1.0) < 1e-3
+    loop = asyncio.new_event_loop()
+    lat = []
+    t0 = time.perf_counter()
+    for r in range(requests):
+        t1 = time.perf_counter()
+        loop.run_until_complete(one(r))
+        lat.append((time.perf_counter() - t1) * 1e3)
+    total = time.perf_counter() - t0
+    emit(
+        2,
+        f"multichat weighted consensus answers/sec, N={n}, {backends} backends, bge-large-en",
+        requests / total,
+        "answers/sec",
+        p50_ms=round(statistics.median(lat), 2),
+        requests=requests,
+    )
+
+
+def bench_rm_reranking(n: int, seq: int, requests: int) -> None:
+    """Config 3: deberta-v3 RM scores candidates; softmax(reward) replaces
+    the cosine vote."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from llm_weighted_consensus_tpu.models import deberta
+    from llm_weighted_consensus_tpu.models.configs import DEBERTA_V3_BASE
+    from llm_weighted_consensus_tpu.models.tokenizer import HashTokenizer
+
+    config = DEBERTA_V3_BASE
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    params = deberta.init_params(jax.random.PRNGKey(0), config, dtype=dtype)
+    tok = HashTokenizer(config.vocab_size)
+    reqs = make_requests(requests, n)
+
+    @partial(jax.jit, static_argnames=())
+    def rm_vote(params, ids, mask):
+        rewards = deberta.reward(params, ids, mask, config)
+        return deberta.reward_consensus_vote(rewards)
+
+    def score(texts):
+        ids, mask = tok.encode_batch(texts, seq)
+        return rm_vote(params, jnp.asarray(ids), jnp.asarray(mask))
+
+    for w in range(2):
+        np.asarray(score(reqs[w % len(reqs)]))
+    lat = []
+    for texts in reqs[: min(20, len(reqs))]:
+        t0 = time.perf_counter()
+        np.asarray(score(texts))
+        lat.append((time.perf_counter() - t0) * 1e3)
+    pool = ThreadPoolExecutor(8)
+    t0 = time.perf_counter()
+    futs = [pool.submit(np.asarray, score(texts)) for texts in reqs]
+    for f in futs:
+        f.result()
+    total = time.perf_counter() - t0
+    pool.shutdown()
+    emit(
+        3,
+        f"RM re-ranking answers/sec, N={n}, deberta-v3-base",
+        len(reqs) / total,
+        "answers/sec",
+        p50_ms=round(statistics.median(lat), 2),
+        requests=len(reqs),
+    )
+
+
+def bench_archive_rescore(total_completions: int) -> None:
+    """Config 4: re-tally stored votes for 10k archived completions in one
+    device batch (the re-weighting scenario; SURVEY §5 checkpoint row)."""
+    from llm_weighted_consensus_tpu.parallel.batch import rescore_batch
+
+    m, n = 8, 4
+    rng = np.random.default_rng(0)
+    votes = rng.random((total_completions, m, n)).astype(np.float32)
+    votes /= votes.sum(axis=2, keepdims=True)
+    weights = rng.random((total_completions, m)).astype(np.float32)
+    # warm-up / compile at the measured shape
+    np.asarray(rescore_batch(votes, weights)[1])
+    t0 = time.perf_counter()
+    _, conf = rescore_batch(votes, weights)
+    conf = np.asarray(conf)
+    total = time.perf_counter() - t0
+    np.testing.assert_allclose(conf.sum(axis=1), 1.0, atol=1e-4)
+    emit(
+        4,
+        f"archive batch re-score, {total_completions} completions (M={m}, N={n})",
+        total_completions / total,
+        "completions/sec",
+        batch_seconds=round(total, 4),
+    )
+
+
+def bench_streaming_incremental(n: int, requests: int) -> None:
+    """Config 5: multichat stream with live consensus updates — each
+    finished candidate embeds + revotes on device via the async
+    (executor-offloaded) path the gateway serves."""
+    import jax
+    import jax.numpy as jnp
+
+    from fakes import Script, chunk_obj
+
+    from llm_weighted_consensus_tpu.clients.multichat import (
+        StreamingSelfConsistency,
+    )
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+    from llm_weighted_consensus_tpu.types.multichat_request import (
+        ChatCompletionCreateParams,
+    )
+
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    embedder = TpuEmbedder("bge-large-en", max_tokens=128, dtype=dtype)
+    model = _make_panel(n, 3)
+    params = ChatCompletionCreateParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "solve"}],
+            "model": {"llms": [llm.base.to_json_obj() for llm in model.llms]},
+        }
+    )
+
+    async def one(r):
+        client = _multichat_client(
+            [
+                Script([chunk_obj(f"req {r} answer {i % 4}", finish="stop")])
+                for i in range(n)
+            ]
+        )
+        sc = StreamingSelfConsistency(embedder)
+        updates = 0
+        stream = await client.create_streaming(None, params)
+        async for chunk in stream:
+            if await sc.push_chunk_async(chunk) is not None:
+                updates += 1
+        assert updates == n - 1
+        assert abs(sum(sc.confidence.values()) - 1.0) < 1e-3
+        return updates
+
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(one(0))  # warm-up/compile
+    t0 = time.perf_counter()
+    updates = sum(loop.run_until_complete(one(r)) for r in range(1, requests + 1))
+    total = time.perf_counter() - t0
+    emit(
+        5,
+        f"streaming incremental consensus updates/sec, N={n}, bge-large-en",
+        updates / total,
+        "updates/sec",
+        stream_seconds_per_request=round(total / requests, 3),
+        requests=requests,
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+    q = args.quick
+
+    bench_self_consistency(
+        "bge-small-en", n=8, seq=128, requests=10 if q else 100, config_num=1
+    )
+    bench_multichat_weighted(
+        n=32, backends=3, requests=3 if q else 20
+    )
+    bench_rm_reranking(n=16, seq=128, requests=5 if q else 50)
+    bench_archive_rescore(10_000)
+    bench_streaming_incremental(n=8 if q else 32, requests=2 if q else 5)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -215,9 +215,10 @@ class StreamingSelfConsistency:
         self.failed: set = set()
         self.confidence: dict = {}
 
-    def push_chunk(self, chunk: ChatCompletionChunk) -> Optional[dict]:
-        """Returns {slot: confidence} when the distribution updates."""
-        updated = False
+    def _absorb(self, chunk: ChatCompletionChunk) -> list:
+        """Fold a chunk into the text accumulators; returns the slots that
+        just finished and now need embedding (pure host work)."""
+        pending = []
         for choice in chunk.choices:
             slot = choice.index
             if choice.delta.content:
@@ -231,12 +232,43 @@ class StreamingSelfConsistency:
                 and slot not in self.embeddings
                 and slot not in self.failed
             ):
-                text = self.texts.get(slot, "")
-                self.embeddings[slot] = self.embedder.embed_texts([text])[0]
-                updated = True
-        if not updated or len(self.embeddings) < 2:
+                pending.append(slot)
+        return pending
+
+    def _embed_slots(self, slots: list) -> None:
+        vecs = self.embedder.embed_texts(
+            [self.texts.get(s, "") for s in slots]
+        )
+        for slot, vec in zip(slots, vecs):
+            self.embeddings[slot] = vec
+
+    def push_chunk(self, chunk: ChatCompletionChunk) -> Optional[dict]:
+        """Returns {slot: confidence} when the distribution updates.
+
+        Blocking variant (embeds + revotes inline); async consumers must
+        use ``push_chunk_async`` so the device work never stalls the event
+        loop."""
+        pending = self._absorb(chunk)
+        if pending:
+            self._embed_slots(pending)
+        if not pending or len(self.embeddings) < 2:
             return None
         return self._recompute()
+
+    async def push_chunk_async(
+        self, chunk: ChatCompletionChunk
+    ) -> Optional[dict]:
+        """``push_chunk`` with the embed + revote device dispatches moved to
+        an executor thread (VERDICT r1 item 8: the blocking embed stalled
+        the event loop on every finished candidate)."""
+        pending = self._absorb(chunk)
+        if not pending:
+            return None
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._embed_slots, pending)
+        if len(self.embeddings) < 2:
+            return None
+        return await loop.run_in_executor(None, self._recompute)
 
     def _recompute(self) -> dict:
         import jax.numpy as jnp
@@ -246,10 +278,30 @@ class StreamingSelfConsistency:
 
         slots = sorted(self.embeddings)
         vecs = np.stack([self.embeddings[s] for s in slots])
-        conf = fused_cosine_vote(
-            jnp.asarray(vecs), temperature=self.temperature
+        # ONE host fetch for the whole distribution (a float() per element
+        # costs one link round-trip each — catastrophic over a tunnel)
+        conf = np.asarray(
+            fused_cosine_vote(jnp.asarray(vecs), temperature=self.temperature)
         )
         self.confidence = {
-            slot: float(c) for slot, c in zip(slots, list(conf))
+            slot: float(c) for slot, c in zip(slots, conf)
         }
         return dict(self.confidence)
+
+
+class ConsensusUpdate:
+    """In-stream consensus frame (a wire extension — the reference has no
+    multichat client at all, SURVEY §2.10): emitted by the gateway between
+    multichat chunks as the live confidence distribution tightens."""
+
+    def __init__(self, confidence: dict):
+        self.confidence = confidence
+
+    def to_json_obj(self) -> dict:
+        return {
+            "object": "multichat.consensus",
+            "confidence": {
+                str(slot): conf
+                for slot, conf in sorted(self.confidence.items())
+            },
+        }

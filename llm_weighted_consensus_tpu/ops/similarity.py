@@ -76,6 +76,53 @@ def top_k_similar(table: jax.Array, queries: jax.Array, k: int):
 
 
 @partial(jax.jit, static_argnames=("k",))
+def training_table_weights_batched(
+    tables: jax.Array,
+    row_mask: jax.Array,
+    table_scores: jax.Array,
+    query: jax.Array,
+    min_weight: jax.Array,
+    max_weight: jax.Array,
+    k: int,
+) -> jax.Array:
+    """Per-judge trained weights with per-judge tables, ONE dispatch.
+
+    tables[J, T, D] judge-specific prompt embeddings padded to a common row
+    count; row_mask[J, T] 1 for real rows; table_scores[J, T] historical
+    accuracy; query[D]; min/max_weight[J].  Returns weights[J].  Padded
+    rows are masked out of the top-k and of the attention softmax, so a
+    judge with fewer than ``k`` real rows attends only to its real rows
+    (matching the per-judge ``k=min(top, rows)`` of the loop form).
+    """
+    j, t, d = tables.shape
+    nq = l2_normalize(query)[None, :]  # [1, D]
+    nt = l2_normalize(tables.reshape(j * t, d)).reshape(j, t, d)
+    sims = jnp.einsum(
+        "jtd,od->jt", nt, nq, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    valid = row_mask > 0
+    sims = jnp.where(valid, sims, -jnp.inf)
+    k_eff = min(k, t)
+    top_scores, top_idx = jax.lax.top_k(sims, k_eff)  # [J, k]
+    top_valid = jnp.isfinite(top_scores)
+    # masked softmax over each judge's valid top rows
+    logits = jnp.where(top_valid, top_scores / 0.05, -jnp.inf)
+    mx = jnp.max(
+        jnp.where(top_valid, logits, -1e30), axis=-1, keepdims=True
+    )
+    e = jnp.where(top_valid, jnp.exp(logits - mx), 0.0)
+    attn = e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+    gathered = jnp.take_along_axis(
+        table_scores.astype(jnp.float32), top_idx, axis=-1
+    )  # [J, k]
+    quality = jnp.sum(attn * gathered, axis=-1)  # [J]
+    lo = min_weight.astype(jnp.float32)
+    hi = max_weight.astype(jnp.float32)
+    return lo + (hi - lo) * quality
+
+
+@partial(jax.jit, static_argnames=("k",))
 def training_table_weights(
     table: jax.Array,
     table_scores: jax.Array,

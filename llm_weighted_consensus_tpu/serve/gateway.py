@@ -8,11 +8,15 @@ and unary failures map to HTTP status + the error's message JSON.
 
 from __future__ import annotations
 
+import time as _time
 from typing import Optional
 
 from aiohttp import web
 
 from ..errors import ScoreError, StatusError, to_response_error
+from .metrics import Metrics
+
+METRICS_KEY: web.AppKey = web.AppKey("metrics", Metrics)
 from ..types.base import SchemaError
 from ..types.chat_request import ChatCompletionCreateParams as ChatParams
 from ..types.embeddings import CreateEmbeddingParams
@@ -93,13 +97,56 @@ def _make_handler(params_cls, create_streaming, create_unary):
     return handler
 
 
+async def _with_consensus_frames(stream, embedder, metrics=None):
+    """Interleave live ``multichat.consensus`` frames into a multichat
+    stream; embeds + revotes run on an executor thread (never the loop)."""
+    from ..clients.multichat import ConsensusUpdate, StreamingSelfConsistency
+
+    sc = StreamingSelfConsistency(embedder)
+    try:
+        async for chunk in stream:
+            yield chunk
+            if isinstance(chunk, Exception):
+                continue
+            t0 = _time.perf_counter()
+            update = await sc.push_chunk_async(chunk)
+            if update is not None:
+                if metrics is not None:
+                    metrics.observe(
+                        "device:consensus_update",
+                        (_time.perf_counter() - t0) * 1e3,
+                    )
+                yield ConsensusUpdate(update)
+    finally:
+        # client disconnects surface here as GeneratorExit; the inner
+        # stream's cleanup must still run
+        aclose = getattr(stream, "aclose", None)
+        if aclose is not None:
+            await aclose()
+
+
+def _multichat_streaming(multichat_client, embedder, metrics):
+    async def create_streaming(ctx, params):
+        stream = await multichat_client.create_streaming(ctx, params)
+        if params.consensus and embedder is not None:
+            return _with_consensus_frames(stream, embedder, metrics)
+        return stream
+
+    return create_streaming
+
+
 def build_app(
     chat_client,
     score_client,
     multichat_client=None,
     embedder=None,
+    metrics=None,
 ) -> web.Application:
-    app = web.Application()
+    from .metrics import middleware
+
+    metrics = metrics or Metrics()
+    app = web.Application(middlewares=[middleware(metrics)])
+    app[METRICS_KEY] = metrics
     app.router.add_post(
         "/chat/completions",
         _make_handler(
@@ -121,21 +168,27 @@ def build_app(
             "/multichat/completions",
             _make_handler(
                 MultichatParams,
-                multichat_client.create_streaming,
+                _multichat_streaming(multichat_client, embedder, metrics),
                 multichat_client.create_unary,
             ),
         )
     if embedder is not None:
-        app.router.add_post("/embeddings", _embeddings_handler(embedder))
+        app.router.add_post(
+            "/embeddings", _embeddings_handler(embedder, metrics)
+        )
 
     async def healthz(request):
         return web.json_response({"ok": True})
 
+    async def metrics_handler(request):
+        return web.json_response(metrics.snapshot())
+
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics_handler)
     return app
 
 
-def _embeddings_handler(embedder):
+def _embeddings_handler(embedder, metrics=None):
     async def handler(request: web.Request):
         try:
             params = CreateEmbeddingParams.from_json_obj(
@@ -163,9 +216,14 @@ def _embeddings_handler(embedder):
 
         try:
             # the device forward blocks; keep the event loop responsive
+            t0 = _time.perf_counter()
             resp = await asyncio.get_running_loop().run_in_executor(
                 None, embedder.embeddings_response, params.inputs()
             )
+            if metrics is not None:
+                metrics.observe(
+                    "device:embed", (_time.perf_counter() - t0) * 1e3
+                )
         except Exception as e:
             return _error_response(e)
         return web.Response(

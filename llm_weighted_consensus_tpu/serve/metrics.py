@@ -1,0 +1,80 @@
+"""Out-of-band service metrics (SURVEY §5 metrics row: "add ordinary
+service metrics (qps, p50, device util) out-of-band").
+
+The reference keeps all observability in-band (per-choice
+``completion_metadata`` + usage/cost accounting); that is preserved
+bit-exact in the wire types.  This module adds the service-level view the
+reference lacks: per-endpoint request counts and latency percentiles plus
+device dispatch timings, exposed at ``GET /metrics``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from contextlib import contextmanager
+
+_RESERVOIR = 1024  # recent samples kept per series
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._counts: dict = {}
+        self._errors: dict = {}
+        self._latencies: dict = {}
+        self._started = time.time()
+
+    def observe(self, series: str, ms: float, *, error: bool = False) -> None:
+        self._counts[series] = self._counts.get(series, 0) + 1
+        if error:
+            self._errors[series] = self._errors.get(series, 0) + 1
+        self._latencies.setdefault(series, deque(maxlen=_RESERVOIR)).append(ms)
+
+    @contextmanager
+    def timed(self, series: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        except Exception:
+            self.observe(series, (time.perf_counter() - t0) * 1e3, error=True)
+            raise
+        self.observe(series, (time.perf_counter() - t0) * 1e3)
+
+    def snapshot(self) -> dict:
+        out = {}
+        for series, count in sorted(self._counts.items()):
+            lat = sorted(self._latencies.get(series, ()))
+            entry = {"count": count, "errors": self._errors.get(series, 0)}
+            if lat:
+                entry["p50_ms"] = round(lat[len(lat) // 2], 2)
+                entry["p99_ms"] = round(
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2
+                )
+            out[series] = entry
+        return {"uptime_sec": round(time.time() - self._started, 1), "series": out}
+
+
+def middleware(metrics: Metrics):
+    """aiohttp middleware timing every request by route path."""
+    from aiohttp import web
+
+    @web.middleware
+    async def _mw(request, handler):
+        t0 = time.perf_counter()
+        try:
+            resp = await handler(request)
+        except Exception:
+            metrics.observe(
+                f"http:{request.path}",
+                (time.perf_counter() - t0) * 1e3,
+                error=True,
+            )
+            raise
+        metrics.observe(
+            f"http:{request.path}",
+            (time.perf_counter() - t0) * 1e3,
+            error=resp.status >= 400,
+        )
+        return resp
+
+    return _mw

@@ -28,3 +28,7 @@ class ChatCompletionCreateParams(Struct):
     stream: Optional[bool] = field(bool, default=None)
     stream_options: Optional[StreamOptions] = field(StreamOptions, default=None)
     usage: Optional[UsageInclude] = field(UsageInclude, default=None)
+    # extension (no reference analog): when true and the gateway has an
+    # embedder, interleave live ``multichat.consensus`` frames as candidates
+    # finish (BASELINE config 5 — streaming incremental consensus)
+    consensus: Optional[bool] = field(bool, default=None)

@@ -330,3 +330,144 @@ def test_config_single_api_base_fallback():
     c = Config.from_env({"OPENAI_API_BASE": "https://x", "OPENAI_API_KEY": "s"})
     assert [a.api_key for a in c.api_bases()] == ["s"]
     assert Config.from_env({}).openai_apis == []
+
+
+# -- streaming consensus frames + /metrics ------------------------------------
+
+
+def _multichat_body(n_gens, consensus=True):
+    return {
+        "stream": True,
+        "consensus": consensus,
+        "messages": [{"role": "user", "content": "q"}],
+        "model": inline_model([{"model": f"gen-{i}"} for i in range(n_gens)]),
+    }
+
+
+def test_multichat_streaming_consensus_frames():
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    embedder = TpuEmbedder("test-tiny")
+    scripts = [
+        Script([chunk_obj(f"the answer is {i % 2}", finish="stop")])
+        for i in range(3)
+    ]
+    app, _ = make_app(scripts, embedder=embedder)
+
+    async def run(client):
+        resp = await post_json(
+            client, "/multichat/completions", _multichat_body(3)
+        )
+        assert resp.status == 200
+        events = sse_events(await resp.text())
+        assert events[-1] == "[DONE]"
+        frames = [json.loads(e) for e in events[:-1]]
+        consensus = [
+            f for f in frames if f.get("object") == "multichat.consensus"
+        ]
+        # 3 generators finish -> updates at the 2nd and 3rd completion
+        assert len(consensus) == 2
+        final = consensus[-1]["confidence"]
+        assert set(final) == {"0", "1", "2"}
+        assert abs(sum(final.values()) - 1.0) < 1e-5
+        # the metrics endpoint saw the requests and the device updates
+        m = await (await client.get("/metrics")).json()
+        series = m["series"]
+        assert series["http:/multichat/completions"]["count"] == 1
+        assert series["device:consensus_update"]["count"] == 2
+        assert "p50_ms" in series["http:/multichat/completions"]
+
+    go(with_client(app, run))
+
+
+def test_multichat_no_consensus_without_flag():
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    embedder = TpuEmbedder("test-tiny")
+    scripts = [
+        Script([chunk_obj("a", finish="stop")]),
+        Script([chunk_obj("b", finish="stop")]),
+    ]
+    app, _ = make_app(scripts, embedder=embedder)
+
+    async def run(client):
+        resp = await post_json(
+            client, "/multichat/completions", _multichat_body(2, consensus=False)
+        )
+        events = sse_events(await resp.text())
+        frames = [json.loads(e) for e in events[:-1]]
+        assert not any(
+            f.get("object") == "multichat.consensus" for f in frames
+        )
+
+    go(with_client(app, run))
+
+
+def test_metrics_counters_move():
+    app, _ = make_app([Script([chunk_obj("hi", finish="stop")])])
+
+    async def run(client):
+        before = (await (await client.get("/metrics")).json())["series"]
+        assert "http:/chat/completions" not in before
+        await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "q"}]},
+        )
+        after = (await (await client.get("/metrics")).json())["series"]
+        assert after["http:/chat/completions"]["count"] == 1
+        assert after["http:/chat/completions"]["errors"] == 0
+
+    go(with_client(app, run))
+
+
+def test_streaming_consensus_loop_not_blocked():
+    """The loop must keep serving while consensus embeds run (VERDICT r1
+    item 8).  The embedder is artificially slowed to 150 ms per embed; if
+    embeds ran on the loop thread, the concurrent /healthz probes would
+    stall behind them — off-loop, every probe returns fast."""
+    import time as _t
+
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    embedder = TpuEmbedder("test-tiny")
+    real_embed = embedder.embed_texts
+    embed_threads = []
+
+    def slow_embed(texts, max_tokens=None):
+        embed_threads.append(__import__("threading").get_ident())
+        _t.sleep(0.15)
+        return real_embed(texts, max_tokens)
+
+    embedder.embed_texts = slow_embed
+    scripts = [
+        Script([chunk_obj(f"answer {i}", finish="stop")]) for i in range(4)
+    ]
+    app, _ = make_app(scripts, embedder=embedder)
+
+    async def run(client):
+        loop_thread = __import__("threading").get_ident()
+
+        async def stream():
+            resp = await post_json(
+                client, "/multichat/completions", _multichat_body(4)
+            )
+            return await resp.text()
+
+        async def pings():
+            # interleave healthz probes with the streaming request
+            stamps = []
+            for _ in range(8):
+                t0 = asyncio.get_event_loop().time()
+                assert (await client.get("/healthz")).status == 200
+                stamps.append(asyncio.get_event_loop().time() - t0)
+                await asyncio.sleep(0.05)
+            return stamps, loop_thread
+
+        text, (stamps, loop_thread) = await asyncio.gather(stream(), pings())
+        assert "multichat.consensus" in text
+        # embeds ran, off the event-loop thread
+        assert embed_threads and all(t != loop_thread for t in embed_threads)
+        # healthz stays responsive: probes never wait out a 150 ms embed
+        assert max(stamps) < 0.1
+
+    go(with_client(app, run))

@@ -30,7 +30,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
 
-from bench import flops_per_answer, make_requests, tokenize_fixed  # noqa: E402
+from bench import make_requests, tokenize_fixed  # noqa: E402
 
 
 def emit(config: int, metric: str, value: float, unit: str, **extra) -> None:
@@ -173,16 +173,19 @@ def bench_multichat_weighted(n: int, backends: int, requests: int) -> None:
         weighted = vote * weights[: len(vote)]
         return weighted / weighted.sum()
 
-    conf = asyncio.new_event_loop().run_until_complete(one(0))  # warm-up
-    assert abs(conf.sum() - 1.0) < 1e-3
     loop = asyncio.new_event_loop()
-    lat = []
-    t0 = time.perf_counter()
-    for r in range(requests):
-        t1 = time.perf_counter()
-        loop.run_until_complete(one(r))
-        lat.append((time.perf_counter() - t1) * 1e3)
-    total = time.perf_counter() - t0
+    try:
+        conf = loop.run_until_complete(one(0))  # warm-up
+        assert abs(conf.sum() - 1.0) < 1e-3
+        lat = []
+        t0 = time.perf_counter()
+        for r in range(requests):
+            t1 = time.perf_counter()
+            loop.run_until_complete(one(r))
+            lat.append((time.perf_counter() - t1) * 1e3)
+        total = time.perf_counter() - t0
+    finally:
+        loop.close()
     emit(
         2,
         f"multichat weighted consensus answers/sec, N={n}, {backends} backends, bge-large-en",
@@ -314,10 +317,15 @@ def bench_streaming_incremental(n: int, requests: int) -> None:
         return updates
 
     loop = asyncio.new_event_loop()
-    loop.run_until_complete(one(0))  # warm-up/compile
-    t0 = time.perf_counter()
-    updates = sum(loop.run_until_complete(one(r)) for r in range(1, requests + 1))
-    total = time.perf_counter() - t0
+    try:
+        loop.run_until_complete(one(0))  # warm-up/compile
+        t0 = time.perf_counter()
+        updates = sum(
+            loop.run_until_complete(one(r)) for r in range(1, requests + 1)
+        )
+        total = time.perf_counter() - t0
+    finally:
+        loop.close()
     emit(
         5,
         f"streaming incremental consensus updates/sec, N={n}, bge-large-en",

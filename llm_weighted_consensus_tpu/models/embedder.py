@@ -25,37 +25,41 @@ from .tokenizer import BaseTokenizer, load_tokenizer
 
 
 @partial(
-    jax.jit, static_argnames=("config", "pooling", "temperature")
+    jax.jit, static_argnames=("n", "config", "pooling", "temperature")
 )
-def _embed_and_vote(params, ids, mask, config, pooling, temperature):
+def _embed_and_vote(params, ids, mask, n, config, pooling, temperature):
     """Single-dispatch self-consistency: encoder forward + cosine consensus
     vote fused under one jit so nothing round-trips the host between them
     (the serving hot path: one upload, one tiny download).  The vote runs
     in the fused Pallas kernel (VMEM-resident normalize+cosine+softmax);
     ``fused_cosine_vote`` itself falls back to the jnp composition beyond
-    its single-block budget."""
+    its single-block budget.  Rows past ``n`` are dp-alignment padding
+    (sliced off before the vote so they cannot perturb the softmax)."""
     from ..ops.kernels import fused_cosine_vote
 
     emb = bert.embed(params, ids, mask, config, pooling=pooling)
     with jax.named_scope("consensus_vote"):
-        return fused_cosine_vote(emb, temperature=temperature)
+        return fused_cosine_vote(emb[:n], temperature=temperature)
 
 
 @partial(
-    jax.jit, static_argnames=("r", "config", "pooling", "temperature")
+    jax.jit, static_argnames=("r", "n", "config", "pooling", "temperature")
 )
-def _embed_and_vote_many(params, ids, mask, r, config, pooling, temperature):
-    """Batched self-consistency: ids/mask[R*N, S] -> confidence[R, N].
+def _embed_and_vote_many(
+    params, ids, mask, r, n, config, pooling, temperature
+):
+    """Batched self-consistency: ids/mask[>=R*N, S] -> confidence[R, N].
 
     R concurrent requests share ONE device dispatch (dynamic batching —
     the encoder sees one [R*N, S] batch), amortizing the host<->device
     round-trip that dominates single-request latency on tunneled links.
     Scoring uses the same fused kernel as the single-request path (one
-    scorer implementation; R is small so the unrolled loop is cheap)."""
+    scorer implementation; R is small so the unrolled loop is cheap).
+    Rows past ``r*n`` are dp-alignment padding, sliced off pre-vote."""
     from ..ops.kernels import fused_cosine_vote
 
     emb = bert.embed(params, ids, mask, config, pooling=pooling)
-    emb = emb.reshape(r, emb.shape[0] // r, -1)
+    emb = emb[: r * n].reshape(r, n, -1)
     with jax.named_scope("consensus_vote_many"):
         return jnp.stack(
             [
@@ -112,6 +116,9 @@ class TpuEmbedder:
             )
         self.params = params
         self.put_batch = lambda ids, mask: (ids, mask)  # mesh hook
+        # batches are padded up to a multiple of this before dispatch so
+        # the dp split divides evenly (shard_embedder sets it to dp)
+        self.batch_multiple = 1
 
     # -- core ----------------------------------------------------------------
 
@@ -144,6 +151,7 @@ class TpuEmbedder:
             ]
             return np.concatenate(chunks, axis=0)
         pad_b = _bucket(b, self.MAX_DEVICE_BATCH)
+        pad_b += (-pad_b) % self.batch_multiple  # keep the dp split divisible
         if pad_b != b:
             ids = np.pad(ids, ((0, pad_b - b), (0, 0)))
             mask = np.pad(mask, ((0, pad_b - b), (0, 0)))
@@ -169,12 +177,25 @@ class TpuEmbedder:
         ids, mask = self.tokenize(texts, max_tokens)
         return self.consensus_confidence_tokens(ids, mask, temperature)
 
+    def _pad_rows(self, ids: np.ndarray, mask: np.ndarray):
+        """Pad the batch dim to a multiple of ``batch_multiple`` so the dp
+        sharding divides evenly.  Pad rows attend to one [PAD] token (a
+        clean forward, no 0/0 pooling); callers slice them off pre-vote."""
+        pad = (-ids.shape[0]) % self.batch_multiple
+        if pad:
+            ids = np.pad(np.asarray(ids), ((0, pad), (0, 0)))
+            mask = np.pad(np.asarray(mask), ((0, pad), (0, 0)))
+            mask[-pad:, 0] = 1
+        return ids, mask
+
     def consensus_confidence_tokens(
         self, ids: np.ndarray, mask: np.ndarray, temperature: float = 0.05
     ):
+        n = ids.shape[0]
+        ids, mask = self._pad_rows(ids, mask)
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
         return _embed_and_vote(
-            self.params, dev_ids, dev_mask, self.config, self.pooling,
+            self.params, dev_ids, dev_mask, n, self.config, self.pooling,
             temperature,
         )
 
@@ -184,12 +205,14 @@ class TpuEmbedder:
         """ids/mask[R, N, S] (R concurrent requests) -> confidence[R, N] in
         ONE device dispatch (dynamic batching for the serving loop)."""
         r, n, s = ids.shape
+        flat_ids, flat_mask = self._pad_rows(
+            ids.reshape(r * n, s), mask.reshape(r * n, s)
+        )
         dev_ids, dev_mask = self.put_batch(
-            jnp.asarray(ids.reshape(r * n, s)),
-            jnp.asarray(mask.reshape(r * n, s)),
+            jnp.asarray(flat_ids), jnp.asarray(flat_mask)
         )
         return _embed_and_vote_many(
-            self.params, dev_ids, dev_mask, r, self.config, self.pooling,
+            self.params, dev_ids, dev_mask, r, n, self.config, self.pooling,
             temperature,
         )
 

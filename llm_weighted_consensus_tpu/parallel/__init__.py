@@ -21,5 +21,6 @@ No pipeline parallelism (a 12-24 layer encoder has no use for stages) and
 no expert parallelism (no MoE) — by design, stated here per SURVEY §2.8.
 """
 
+from .dist import maybe_initialize_distributed  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from . import batch, collectives, sharding  # noqa: F401

@@ -22,6 +22,8 @@ def make_mesh(
     devices = list(devices if devices is not None else jax.devices())
     if dp is None:
         dp = len(devices) // tp
+    if dp < 1 or tp < 1:
+        raise ValueError(f"mesh axes must be >= 1, got dp={dp} tp={tp}")
     n = dp * tp
     if n > len(devices):
         raise ValueError(

@@ -73,14 +73,21 @@ def shard_bert_params(params: dict, mesh: Mesh, tp: bool = True) -> dict:
 
 def shard_embedder(embedder, mesh: Mesh, tp: bool = False) -> None:
     """Wire a models.embedder.TpuEmbedder onto a mesh: params placed
-    (replicated or TP), batches split over ``dp`` via its put_batch hook."""
+    (replicated or TP), batches split over ``dp`` via its put_batch hook.
+
+    Setting ``embedder.batch_multiple = dp`` makes the embedder pad every
+    dispatch to a dp multiple, so the split always divides; the replicated
+    fallback below is a safety net for direct put_batch callers only.
+    """
     embedder.params = shard_bert_params(embedder.params, mesh, tp=tp)
     b_sharding = batch_sharding(mesh)
+    repl = replicated(mesh)
+    dp = mesh.shape.get("dp", 1)
 
     def put_batch(ids, mask):
-        return (
-            jax.device_put(ids, b_sharding),
-            jax.device_put(mask, b_sharding),
-        )
+        s = b_sharding if ids.shape[0] % dp == 0 else repl
+        return jax.device_put(ids, s), jax.device_put(mask, s)
 
     embedder.put_batch = put_batch
+    embedder.batch_multiple = dp
+    embedder.mesh = mesh

@@ -78,6 +78,45 @@ async def _fake_upstream(request: web.Request) -> web.StreamResponse:
     return resp
 
 
+def build_embedder(config: Config):
+    """The service's device side: an embedder from env config, placed on a
+    (dp, tp) mesh when MESH_DP / MESH_TP are set (batches shard over dp,
+    encoder params Megatron-split over tp — parallel/sharding.py)."""
+    if not config.embedder_model:
+        return None
+    from ..models.embedder import TpuEmbedder
+    from ..models.tokenizer import load_tokenizer
+
+    embedder = TpuEmbedder(
+        config.embedder_model,
+        # only override the tokenizer when a real vocab is configured;
+        # TpuEmbedder's default hash fallback sizes to the model vocab
+        tokenizer=(
+            load_tokenizer(config.embedder_vocab)
+            if config.embedder_vocab
+            else None
+        ),
+        max_tokens=config.embedder_max_tokens,
+    )
+    if config.mesh_dp is not None or config.mesh_tp > 1:
+        import jax
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import shard_embedder
+
+        # the serving mesh is HOST-LOCAL: a request lands on one host and
+        # must be executable without the other hosts' cooperation (they
+        # serve their own traffic).  Single-host: local == global.  See
+        # DESIGN.md §multi-host.
+        mesh = make_mesh(
+            dp=config.mesh_dp,
+            tp=config.mesh_tp,
+            devices=jax.local_devices(),
+        )
+        shard_embedder(embedder, mesh, tp=config.mesh_tp > 1)
+    return embedder
+
+
 def build_service(config: Config, fake_upstream: bool = False):
     api_bases = config.api_bases()
     if fake_upstream:
@@ -95,24 +134,11 @@ def build_service(config: Config, fake_upstream: bool = False):
         archive_fetcher=store,
     )
     model_registry = registry.InMemoryModelRegistry()
-    embedder = None
+    embedder = build_embedder(config)
     weight_fetchers = WeightFetchers()
-    if config.embedder_model:
-        from ..models.embedder import TpuEmbedder
-        from ..models.tokenizer import load_tokenizer
+    if embedder is not None:
         from ..weights.training_table import TpuTrainingTableFetcher
 
-        embedder = TpuEmbedder(
-            config.embedder_model,
-            # only override the tokenizer when a real vocab is configured;
-            # TpuEmbedder's default hash fallback sizes to the model vocab
-            tokenizer=(
-                load_tokenizer(config.embedder_vocab)
-                if config.embedder_vocab
-                else None
-            ),
-            max_tokens=config.embedder_max_tokens,
-        )
         weight_fetchers = WeightFetchers(
             training_table_fetcher=TpuTrainingTableFetcher(embedder)
         )
@@ -155,6 +181,10 @@ def main() -> None:
     )
     args = parser.parse_args()
     load_dotenv()
+    # must precede any jax backend use (mesh construction in build_service)
+    from ..parallel.dist import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
     config = Config.from_env()
     if args.port is not None:
         config.port = args.port

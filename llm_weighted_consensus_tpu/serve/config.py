@@ -3,6 +3,20 @@
 Parity: the reference's 16 env vars (main.rs:3-37) with identical names and
 defaults, plus TPU-framework additions (encoder + mesh flags).  ``.env``
 loading mirrors dotenv: simple KEY=VALUE lines, environment wins.
+
+TPU additions:
+
+* ``EMBEDDER_MODEL``  — encoder preset (``bge-small-en`` / ``bge-base-en`` /
+  ``bge-large-en``); unset = no device side (static weights only).
+* ``EMBEDDER_VOCAB``  — path to a WordPiece ``vocab.txt``; unset = hash
+  tokenizer fallback.
+* ``EMBEDDER_MAX_TOKENS`` — truncation window (default 512).
+* ``MESH_DP`` / ``MESH_TP`` — serve the embedder over a (dp, tp) device
+  mesh: batches shard over ``dp``, encoder params Megatron-split over
+  ``tp`` (parallel/sharding.py).  Unset = single device.  ``MESH_DP``
+  empty + ``MESH_TP=n`` uses every device not consumed by tp for dp.
+* ``MULTIHOST`` — set to 1 on each host of a multi-host slice to call
+  ``jax.distributed.initialize`` before mesh construction (parallel/dist.py).
 """
 
 from __future__ import annotations

@@ -14,9 +14,7 @@ from typing import Optional
 from aiohttp import web
 
 from ..errors import ScoreError, StatusError, to_response_error
-from .metrics import Metrics
-
-METRICS_KEY: web.AppKey = web.AppKey("metrics", Metrics)
+from .metrics import Metrics, middleware
 from ..types.base import SchemaError
 from ..types.chat_request import ChatCompletionCreateParams as ChatParams
 from ..types.embeddings import CreateEmbeddingParams
@@ -25,6 +23,8 @@ from ..types.multichat_request import (
 )
 from ..types.score_request import ChatCompletionCreateParams as ScoreParams
 from ..utils import jsonutil
+
+METRICS_KEY: web.AppKey = web.AppKey("metrics", Metrics)
 
 DONE = b"data: [DONE]\n\n"
 SSE_HEADERS = {
@@ -106,10 +106,23 @@ async def _with_consensus_frames(stream, embedder, metrics=None):
     try:
         async for chunk in stream:
             yield chunk
-            if isinstance(chunk, Exception):
+            if isinstance(chunk, Exception) or sc is None:
                 continue
             t0 = _time.perf_counter()
-            update = await sc.push_chunk_async(chunk)
+            try:
+                update = await sc.push_chunk_async(chunk)
+            except Exception:
+                # consensus frames are an overlay on the multichat stream:
+                # an embedder failure degrades to plain multichat (no more
+                # consensus frames) rather than tearing the stream down
+                if metrics is not None:
+                    metrics.observe(
+                        "device:consensus_update",
+                        (_time.perf_counter() - t0) * 1e3,
+                        error=True,
+                    )
+                sc = None
+                continue
             if update is not None:
                 if metrics is not None:
                     metrics.observe(
@@ -142,8 +155,6 @@ def build_app(
     embedder=None,
     metrics=None,
 ) -> web.Application:
-    from .metrics import middleware
-
     metrics = metrics or Metrics()
     app = web.Application(middlewares=[middleware(metrics)])
     app[METRICS_KEY] = metrics

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from contextlib import contextmanager
 
 _RESERVOIR = 1024  # recent samples kept per series
 
@@ -30,16 +29,6 @@ class Metrics:
             self._errors[series] = self._errors.get(series, 0) + 1
         self._latencies.setdefault(series, deque(maxlen=_RESERVOIR)).append(ms)
 
-    @contextmanager
-    def timed(self, series: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        except Exception:
-            self.observe(series, (time.perf_counter() - t0) * 1e3, error=True)
-            raise
-        self.observe(series, (time.perf_counter() - t0) * 1e3)
-
     def snapshot(self) -> dict:
         out = {}
         for series, count in sorted(self._counts.items()):
@@ -54,8 +43,16 @@ class Metrics:
         return {"uptime_sec": round(time.time() - self._started, 1), "series": out}
 
 
+def _series(request) -> str:
+    """Series key = the MATCHED route, so unmatched-path probes can't mint
+    unbounded series (they all bucket under ``http:unmatched``)."""
+    resource = getattr(request.match_info.route, "resource", None)
+    canonical = getattr(resource, "canonical", None)
+    return f"http:{canonical}" if canonical else "http:unmatched"
+
+
 def middleware(metrics: Metrics):
-    """aiohttp middleware timing every request by route path."""
+    """aiohttp middleware timing every request by matched route."""
     from aiohttp import web
 
     @web.middleware
@@ -65,13 +62,13 @@ def middleware(metrics: Metrics):
             resp = await handler(request)
         except Exception:
             metrics.observe(
-                f"http:{request.path}",
+                _series(request),
                 (time.perf_counter() - t0) * 1e3,
                 error=True,
             )
             raise
         metrics.observe(
-            f"http:{request.path}",
+            _series(request),
             (time.perf_counter() - t0) * 1e3,
             error=resp.status >= 400,
         )

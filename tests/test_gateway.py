@@ -471,3 +471,179 @@ def test_streaming_consensus_loop_not_blocked():
         assert max(stamps) < 0.1
 
     go(with_client(app, run))
+
+
+# -- mesh-configured serving (MESH_DP / MESH_TP) ------------------------------
+
+
+def test_mesh_dp_service_round_trip():
+    """MESH_DP=8 -> build_embedder places the device side on a dp mesh;
+    /embeddings and a trained-weights score request round-trip through the
+    dp-sharded embedder."""
+    pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+    from llm_weighted_consensus_tpu.weights import WeightFetchers
+    from llm_weighted_consensus_tpu.weights.training_table import (
+        TpuTrainingTableFetcher,
+    )
+
+    config = Config.from_env(
+        {
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_MAX_TOKENS": "32",
+            "MESH_DP": "8",
+        }
+    )
+    embedder = build_embedder(config)
+    assert dict(embedder.mesh.shape) == {"dp": 8, "tp": 1}
+    ids, mask = embedder.tokenize(["text"] * 8)
+    dev_ids, _ = embedder.put_batch(jnp.asarray(ids), jnp.asarray(mask))
+    assert dev_ids.sharding.spec == P("dp", None)
+    # uneven batches degrade to replicated placement, not an error
+    ids5, mask5 = embedder.tokenize(["text"] * 5)
+    dev5, _ = embedder.put_batch(jnp.asarray(ids5), jnp.asarray(mask5))
+    assert dev5.sharding.spec == P()
+    # ...but the consensus hot path pads to the dp multiple, so N=5
+    # candidates still take the dp-split fast path — and padding must not
+    # perturb the vote (same softmax as an unsharded embedder)
+    assert embedder.batch_multiple == 8
+    import numpy as np
+
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    texts5 = [f"candidate {i}" for i in range(5)]
+    conf = np.asarray(embedder.consensus_confidence(texts5))
+    plain = TpuEmbedder(
+        "test-tiny", config=TEST_TINY, max_tokens=32, seed=0
+    )
+    np.testing.assert_allclose(
+        conf, np.asarray(plain.consensus_confidence(texts5)), atol=1e-5
+    )
+
+    keys = ballot_keys(2)
+    transport = FakeTransport(
+        [Script([chunk_obj(f"pick {keys[0]}", finish="stop")])]
+    )
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    reg = registry.InMemoryModelRegistry()
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat,
+        reg,
+        archive_fetcher=store,
+        weight_fetchers=WeightFetchers(
+            training_table_fetcher=TpuTrainingTableFetcher(embedder)
+        ),
+        rng_factory=lambda: random.Random(SEED),
+    )
+    app = build_app(chat, score, None, embedder)
+
+    async def run(client):
+        resp = await client.post(
+            "/embeddings", json={"model": "test-tiny", "input": ["a", "b"]}
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert len(body["data"]) == 2
+
+        resp = await post_json(
+            client,
+            "/score/completions",
+            {
+                "messages": [{"role": "user", "content": "q"}],
+                "model": {
+                    "llms": [
+                        {
+                            "model": "j1",
+                            "weight": {
+                                "type": "training_table",
+                                "base_weight": 1,
+                                "min_weight": 1,
+                                "max_weight": 5,
+                            },
+                        }
+                    ],
+                    "weight": {
+                        "type": "training_table",
+                        "embeddings": {
+                            "model": "test-tiny", "max_tokens": 32
+                        },
+                        "top": 3,
+                    },
+                },
+                "choices": ["first", "second"],
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        # weight evidence from the on-mesh embedder is echoed back
+        assert body["weight_data"] is not None
+        usage = body["weight_data"]["embeddings_response"]["usage"]
+        assert usage["total_tokens"] > 0
+        cand = {c["index"]: c for c in body["choices"] if c["index"] < 2}
+        assert cand[0]["confidence"] == 1
+
+    go(with_client(app, run))
+
+
+def test_consensus_overlay_degrades_on_embedder_failure():
+    """An embedder crash mid-stream must not tear down the multichat SSE
+    stream: consensus frames stop, multichat chunks keep flowing, [DONE]
+    still terminates."""
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    embedder = TpuEmbedder("test-tiny")
+
+    def boom(texts, max_tokens=None):
+        raise RuntimeError("device OOM")
+
+    embedder.embed_texts = boom
+    scripts = [
+        Script([chunk_obj(f"answer {i}", finish="stop")]) for i in range(3)
+    ]
+    app, _ = make_app(scripts, embedder=embedder)
+
+    async def run(client):
+        resp = await post_json(
+            client, "/multichat/completions", _multichat_body(3)
+        )
+        assert resp.status == 200
+        events = sse_events(await resp.text())
+        assert events[-1] == "[DONE]"
+        frames = [json.loads(e) for e in events[:-1]]
+        assert not any(
+            f.get("object") == "multichat.consensus" for f in frames
+        )
+        # every generator's answer still arrived
+        texts = {
+            c["delta"].get("content")
+            for f in frames
+            for c in f.get("choices", [])
+            if c.get("delta", {}).get("content")
+        }
+        assert texts == {"answer 0", "answer 1", "answer 2"}
+        # the failure was recorded out-of-band
+        m = await (await client.get("/metrics")).json()
+        assert m["series"]["device:consensus_update"]["errors"] >= 1
+
+    go(with_client(app, run))
+
+
+def test_metrics_unmatched_paths_bucket_together():
+    app, _ = make_app([])
+
+    async def run(client):
+        for path in ("/nope-a", "/nope-b", "/nope-c"):
+            assert (await client.get(path)).status == 404
+        m = await (await client.get("/metrics")).json()
+        series = m["series"]
+        assert series["http:unmatched"]["count"] == 3
+        assert not any("nope" in k for k in series)
+
+    go(with_client(app, run))

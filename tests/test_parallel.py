@@ -179,3 +179,41 @@ def test_graft_dryrun_subprocess_fallback():
 
     assert len(jax.devices()) < 16
     ge.dryrun_multichip(16)
+
+
+def test_multihost_flag_off_is_noop(monkeypatch):
+    from llm_weighted_consensus_tpu.parallel import dist
+
+    called = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: called.append(kw)
+    )
+    assert dist.maybe_initialize_distributed({}) is False
+    assert dist.maybe_initialize_distributed({"MULTIHOST": "0"}) is False
+    assert called == []
+
+
+def test_multihost_flag_parses_env(monkeypatch):
+    from llm_weighted_consensus_tpu.parallel import dist
+
+    called = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: called.append(kw)
+    )
+    env = {
+        "MULTIHOST": "1",
+        "COORDINATOR_ADDRESS": "10.0.0.1:8476",
+        "NUM_PROCESSES": "2",
+        "PROCESS_ID": "1",
+    }
+    assert dist.maybe_initialize_distributed(env) is True
+    assert called == [
+        {
+            "coordinator_address": "10.0.0.1:8476",
+            "num_processes": 2,
+            "process_id": 1,
+        }
+    ]
+    # autodetection path: flag alone passes no kwargs
+    assert dist.maybe_initialize_distributed({"MULTIHOST": "true"}) is True
+    assert called[-1] == {}

@@ -49,7 +49,7 @@ from ..types.base import SchemaError, fold_chunks
 from ..types.chat_request import ChatCompletionCreateParams, StreamOptions
 from ..types.chat_response import ChatCompletion, ChatCompletionChunk
 from ..utils import jsonutil
-from .sse import SSEParser
+from .sse import make_parser
 
 DONE_FRAME = "[DONE]"
 
@@ -249,6 +249,12 @@ class DefaultChatClient(ChatClient):
         self.other_chunk_timeout_ms = other_chunk_timeout_ms
         self.ctx_handler = ctx_handler or CtxHandler()
         self.archive_fetcher = archive_fetcher or archive_mod.UnimplementedFetcher()
+        # compile/load the native SSE parser NOW (sync startup context) so
+        # make_parser() inside the async decode loop never blocks the loop
+        # on a g++ run
+        from .sse import load_native_library
+
+        load_native_library()
 
     # -- public API ---------------------------------------------------------
 
@@ -366,7 +372,8 @@ class DefaultChatClient(ChatClient):
                 yield BadStatusError(resp.status, parsed)
                 return
 
-            parser = SSEParser()
+            # native C++ parser when built (hot loop #1), Python fallback
+            parser = make_parser()
             byte_iter = resp.byte_stream().__aiter__()
             first = True
             pending: list = []

@@ -2,9 +2,12 @@
 
 Parses a raw byte stream into SSE ``data:`` payloads.  This is hot loop #1
 of the serving path (SURVEY §3.5): per-token work on every judge stream.
-The pure-Python implementation here has a C++ twin in ``native/`` (same
-frame semantics, used when the extension is built); both are exercised by
-tests/test_sse.py.
+The pure-Python ``SSEParser`` has a C++ twin (``native/sse_parser.cpp``,
+loaded through ctypes as ``NativeSSEParser``); ``make_parser`` picks the
+native one when the shared library builds/loads, falling back silently
+otherwise.  Both are run over one corpus by tests/test_native.py (split
+feeds, CRLF, comments, flush).  Set ``LWC_NATIVE_SSE=0`` to force the
+Python parser.
 
 Frame semantics (the subset OpenAI-compatible providers emit, matching what
 reqwest-eventsource accepts in the reference — chat client.rs:334-434):
@@ -15,6 +18,9 @@ a blank line, ``:`` comment lines and other fields (``event:``/``id:``/
 
 from __future__ import annotations
 
+import ctypes
+import os
+import subprocess
 from typing import Iterator, Optional
 
 
@@ -59,9 +65,139 @@ class SSEParser:
         return None
 
     def flush(self) -> Optional[str]:
-        """End-of-stream: dispatch any trailing un-terminated event."""
+        """End-of-stream: the remaining buffered bytes count as a final
+        (newline-less) line, then any open event is dispatched — streams
+        cut mid-event still surface their last frame."""
+        if self._buffer:
+            line = bytes(self._buffer)
+            self._buffer = bytearray()
+            if line.endswith(b"\r"):
+                line = line[:-1]
+            # the residual may itself be the dispatching blank line (stream
+            # cut between CR and LF): surface that event too
+            event = self._feed_line(line)
+            if event is not None:
+                return event
         if self._data_lines:
             event = "\n".join(self._data_lines)
             self._data_lines = []
             return event
         return None
+
+
+# -- native twin --------------------------------------------------------------
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_NATIVE_SO = os.path.join(_NATIVE_DIR, "liblwc_native.so")
+_native_lib = None
+_native_tried = False
+
+
+def load_native_library():
+    """The C++ parser's shared library, compiled on first call.  Blocking —
+    call it from sync startup code (DefaultChatClient.__init__ does), never
+    from the event loop; ``make_parser`` afterwards only reads the cache.
+    The compile goes to a temp file then ``os.replace`` so concurrent
+    builders can't hand anyone a truncated .so (and processes that already
+    mapped the old inode keep it).  Returns None — and remembers the
+    failure — when the library can't be built or loaded, or when
+    ``LWC_NATIVE_SSE=0``."""
+    global _native_lib, _native_tried
+    if _native_tried:
+        return _native_lib
+    _native_tried = True
+    if os.environ.get("LWC_NATIVE_SSE", "1").lower() in ("0", "false", "no"):
+        return None
+    try:
+        src = os.path.join(_NATIVE_DIR, "sse_parser.cpp")
+        if not os.path.exists(_NATIVE_SO) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_NATIVE_SO)
+        ):
+            tmp = f"{_NATIVE_SO}.tmp.{os.getpid()}"
+            subprocess.run(
+                [
+                    "g++", "-O3", "-std=c++17", "-fPIC", "-Wall",
+                    "-shared", "-o", tmp, src,
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, _NATIVE_SO)
+        lib = ctypes.CDLL(_NATIVE_SO)
+        lib.sse_parser_new.restype = ctypes.c_void_p
+        lib.sse_parser_new.argtypes = []
+        lib.sse_parser_free.argtypes = [ctypes.c_void_p]
+        lib.sse_parser_feed.restype = ctypes.c_size_t
+        lib.sse_parser_feed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.sse_parser_next_event.restype = ctypes.c_void_p
+        lib.sse_parser_next_event.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.sse_parser_flush.restype = ctypes.c_size_t
+        lib.sse_parser_flush.argtypes = [ctypes.c_void_p]
+        _native_lib = lib
+    except Exception:
+        _native_lib = None
+    return _native_lib
+
+
+class NativeSSEParser:
+    """ctypes wrapper over native/sse_parser.cpp — same interface and frame
+    semantics as ``SSEParser`` (parity-tested in tests/test_native.py)."""
+
+    def __init__(self, lib=None) -> None:
+        self._lib = lib or load_native_library()
+        if self._lib is None:
+            raise RuntimeError("native SSE parser unavailable")
+        self._handle = self._lib.sse_parser_new()
+
+    def _drain(self) -> Iterator[str]:
+        out_len = ctypes.c_size_t()
+        while True:
+            ptr = self._lib.sse_parser_next_event(
+                self._handle, ctypes.byref(out_len)
+            )
+            if not ptr:
+                return
+            yield ctypes.string_at(ptr, out_len.value).decode(
+                "utf-8", errors="replace"
+            )
+
+    def feed(self, data: bytes) -> Iterator[str]:
+        self._lib.sse_parser_feed(self._handle, data, len(data))
+        return self._drain()
+
+    def flush(self) -> Optional[str]:
+        if self._lib.sse_parser_flush(self._handle) == 0:
+            return None
+        return next(self._drain(), None)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.sse_parser_free(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_parser():
+    """The serving path's parser factory: native when available, else the
+    pure-Python implementation (identical semantics either way)."""
+    lib = load_native_library()
+    if lib is not None:
+        return NativeSSEParser(lib)
+    return SSEParser()

@@ -79,6 +79,12 @@ struct Parser {
   }
 
   bool flush() {
+    // remaining buffered bytes count as a final (newline-less) line, so
+    // streams cut mid-event still surface their last frame
+    if (!buffer.empty()) {
+      feed_line(buffer.data(), buffer.size());
+      buffer.clear();
+    }
     if (!has_data) return false;
     events.emplace_back(std::move(data));
     data.clear();

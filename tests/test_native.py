@@ -1,0 +1,207 @@
+"""Parity corpus for the two SSE parsers (Python SSEParser + C++
+NativeSSEParser via ctypes): identical events for every corpus entry under
+every chunk split, CRLF, comments, non-data fields, and flush semantics.
+
+The native library builds on demand (``make -C native``); tests skip if the
+toolchain can't produce it.
+"""
+
+import pytest
+
+from llm_weighted_consensus_tpu.clients import sse
+
+CORPUS = [
+    # (name, raw bytes, expected events, expected flush tail)
+    (
+        "single event",
+        b"data: hello\n\n",
+        ["hello"],
+        None,
+    ),
+    (
+        "two events",
+        b"data: one\n\ndata: two\n\n",
+        ["one", "two"],
+        None,
+    ),
+    (
+        "multi-line data joined by newline",
+        b"data: line1\ndata: line2\n\n",
+        ["line1\nline2"],
+        None,
+    ),
+    (
+        "crlf endings",
+        b"data: a\r\n\r\ndata: b\r\n\r\n",
+        ["a", "b"],
+        None,
+    ),
+    (
+        "comments ignored",
+        b": keep-alive\ndata: x\n: another\n\n",
+        ["x"],
+        None,
+    ),
+    (
+        "other fields ignored",
+        b"event: message\nid: 7\nretry: 100\ndata: y\n\n",
+        ["y"],
+        None,
+    ),
+    (
+        "no space after colon",
+        b"data:tight\n\n",
+        ["tight"],
+        None,
+    ),
+    (
+        "only first space stripped",
+        b"data:  two spaces\n\n",
+        [" two spaces"],
+        None,
+    ),
+    (
+        "bare data line (no colon)",
+        b"data\n\n",
+        [""],
+        None,
+    ),
+    (
+        "empty data value",
+        b"data:\n\n",
+        [""],
+        None,
+    ),
+    (
+        "blank line without data is not an event",
+        b"\n\n: c\n\ndata: z\n\n",
+        ["z"],
+        None,
+    ),
+    (
+        "trailing unterminated event -> flush",
+        b"data: done-frame",
+        [],
+        "done-frame",
+    ),
+    (
+        "unterminated multi-line -> flush",
+        b"data: p\ndata: q",
+        [],
+        "p\nq",
+    ),
+    (
+        "done terminator frame",
+        b'data: {"k": 1}\n\ndata: [DONE]\n\n',
+        ['{"k": 1}', "[DONE]"],
+        None,
+    ),
+    (
+        "unicode",
+        "data: voilà ✓\n\n".encode("utf-8"),
+        ["voilà ✓"],
+        None,
+    ),
+    (
+        "stream cut between CR and LF of the blank line",
+        b"data: x\n\r",
+        [],
+        "x",
+    ),
+    (
+        "stream cut right after the data line's LF",
+        b"data: y\n",
+        [],
+        "y",
+    ),
+]
+
+SPLITS = [1, 2, 3, 7, 1 << 30]  # feed chunk sizes; last = one shot
+
+
+def run_parser(parser, raw: bytes, split: int):
+    events = []
+    for i in range(0, len(raw), split):
+        events.extend(parser.feed(raw[i : i + split]))
+    tail = parser.flush()
+    return events, tail
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = sse.load_native_library()
+    if lib is None:
+        pytest.skip("native SSE parser not buildable here")
+    return lib
+
+
+@pytest.mark.parametrize(
+    "name,raw,expected,tail", CORPUS, ids=[c[0] for c in CORPUS]
+)
+@pytest.mark.parametrize("split", SPLITS)
+def test_python_parser_corpus(name, raw, expected, tail, split):
+    events, got_tail = run_parser(sse.SSEParser(), raw, split)
+    assert events == expected
+    assert got_tail == tail
+
+
+@pytest.mark.parametrize(
+    "name,raw,expected,tail", CORPUS, ids=[c[0] for c in CORPUS]
+)
+@pytest.mark.parametrize("split", SPLITS)
+def test_native_parser_corpus(native_lib, name, raw, expected, tail, split):
+    events, got_tail = run_parser(sse.NativeSSEParser(native_lib), raw, split)
+    assert events == expected
+    assert got_tail == tail
+
+
+def test_parsers_agree_on_random_streams(native_lib):
+    import random
+
+    rng = random.Random(7)
+    fields = [
+        b"data: payload %d\n",
+        b"data:x%d\n",
+        b"\n",
+        b"\r\n",
+        b": comment %d\n",
+        b"event: e%d\n",
+        b"data: multi\ndata: line %d\n",
+    ]
+    for trial in range(50):
+        raw = b"".join(
+            (f % i if b"%d" in f else f)
+            for i, f in (
+                (i, rng.choice(fields))
+                for i in range(rng.randint(1, 30))
+            )
+        )
+        split = rng.choice([1, 2, 5, 13, len(raw) or 1])
+        py = run_parser(sse.SSEParser(), raw, split)
+        nat = run_parser(sse.NativeSSEParser(native_lib), raw, split)
+        assert py == nat, f"trial {trial}: {raw!r}"
+
+
+def test_make_parser_prefers_native_and_falls_back(monkeypatch):
+    lib = sse.load_native_library()
+    p = sse.make_parser()
+    if lib is not None:
+        assert isinstance(p, sse.NativeSSEParser)
+    else:
+        assert isinstance(p, sse.SSEParser)
+    # forced fallback
+    monkeypatch.setattr(sse, "_native_lib", None)
+    monkeypatch.setattr(sse, "_native_tried", True)
+    assert isinstance(sse.make_parser(), sse.SSEParser)
+
+
+def test_native_parser_is_on_the_chat_client_path(native_lib):
+    """The chat client's decode loop constructs its parser via make_parser,
+    so the native parser serves real streams when built."""
+    import inspect
+
+    from llm_weighted_consensus_tpu.clients import chat
+
+    src = inspect.getsource(chat)
+    assert "make_parser()" in src
+    assert isinstance(sse.make_parser(), sse.NativeSSEParser)

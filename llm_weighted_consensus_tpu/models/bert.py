@@ -140,7 +140,12 @@ def _use_fused_attention(config: BertConfig, s: int, hd: int) -> bool:
 def _layer(x, p, mask_bias, config: BertConfig):
     attn = _attention(x, p, mask_bias, config)
     x = _layer_norm(x + attn, p["attn_ln"], config.layer_norm_eps)
-    mlp = _dense(jax.nn.gelu(_dense(x, p["mlp_in"])), p["mlp_out"])
+    # exact (erf) GELU: HF BERT/bge checkpoints use hidden_act="gelu",
+    # which is erf-based — jax.nn.gelu's default tanh approximation would
+    # silently diverge from real checkpoints (tests/test_hf_parity.py)
+    mlp = _dense(
+        jax.nn.gelu(_dense(x, p["mlp_in"]), approximate=False), p["mlp_out"]
+    )
     return _layer_norm(x + mlp, p["mlp_ln"], config.layer_norm_eps)
 
 

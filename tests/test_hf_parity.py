@@ -1,0 +1,254 @@
+"""Golden parity vs HuggingFace BERT semantics (VERDICT r1 #6).
+
+No real bge checkpoint exists in this image (no network, no HF cache), so
+parity is proven structurally: a randomly-initialized ``transformers``
+BertModel's state dict is imported through ``bert.from_hf_weights`` and the
+two forwards must agree to float tolerance.  That validates every silent
+choice — GELU variant (erf, not tanh), CLS pooling, LayerNorm eps/order,
+embedding composition, mask handling — against the implementation real
+checkpoints were trained with.  Tokenization is checked the same way:
+our WordPiece vs ``transformers.BertTokenizer`` over one vocab file.
+
+A real-checkpoint golden test runs when ``LWC_BGE_DIR`` points at a local
+HF-layout checkpoint dir (config.json + pytorch_model.bin/model.safetensors
++ vocab.txt); otherwise it skips, stating the expected layout.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from llm_weighted_consensus_tpu.models import bert
+from llm_weighted_consensus_tpu.models.configs import BertConfig
+from llm_weighted_consensus_tpu.models.tokenizer import WordPieceTokenizer
+
+TINY = BertConfig(
+    vocab_size=512,
+    hidden_size=64,
+    num_layers=3,
+    num_heads=4,
+    intermediate_size=128,
+    max_position_embeddings=64,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    hf_config = transformers.BertConfig(
+        vocab_size=TINY.vocab_size,
+        hidden_size=TINY.hidden_size,
+        num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads,
+        intermediate_size=TINY.intermediate_size,
+        max_position_embeddings=TINY.max_position_embeddings,
+        type_vocab_size=TINY.type_vocab_size,
+        layer_norm_eps=TINY.layer_norm_eps,
+        hidden_act="gelu",  # bge checkpoints use exact (erf) gelu
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.BertModel(hf_config, add_pooling_layer=False)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def our_params(hf_model):
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    return bert.from_hf_weights(state, TINY)
+
+
+def batch(with_padding=True):
+    rng = np.random.default_rng(1)
+    b, s = 4, 24
+    ids = rng.integers(5, TINY.vocab_size, (b, s)).astype(np.int32)
+    mask = np.ones((b, s), dtype=np.int32)
+    if with_padding:
+        # ragged: rows end at different lengths, pads are id 0
+        for i, n in enumerate((24, 17, 9, 13)):
+            ids[i, n:] = 0
+            mask[i, n:] = 0
+    return ids, mask
+
+
+def test_hidden_states_match_hf(hf_model, our_params):
+    ids, mask = batch()
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    ours = np.asarray(
+        bert.encode(our_params, jnp.asarray(ids), jnp.asarray(mask), TINY)
+    )
+    # only real-token positions must agree (HF computes garbage values at
+    # padded positions too, but nothing downstream reads them)
+    real = mask.astype(bool)
+    np.testing.assert_allclose(ours[real], ref[real], atol=2e-4, rtol=1e-3)
+
+
+def test_cls_pooling_and_normalize_match_hf(hf_model, our_params):
+    """bge semantics: CLS token + l2 normalize."""
+    ids, mask = batch()
+    with torch.no_grad():
+        hidden = hf_model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state
+        cls = hidden[:, 0]
+        ref = torch.nn.functional.normalize(cls, p=2, dim=-1).numpy()
+    ours = np.asarray(
+        bert.embed(
+            our_params,
+            jnp.asarray(ids),
+            jnp.asarray(mask),
+            TINY,
+            pooling="cls",
+            normalize=True,
+        )
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=1e-3)
+
+
+def test_mean_pooling_matches_sentence_transformers_recipe(
+    hf_model, our_params
+):
+    ids, mask = batch()
+    with torch.no_grad():
+        hidden = hf_model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state
+        m = torch.tensor(mask, dtype=torch.float32)[:, :, None]
+        ref = (hidden * m).sum(1) / m.sum(1)
+        ref = torch.nn.functional.normalize(ref, p=2, dim=-1).numpy()
+    ours = np.asarray(
+        bert.embed(
+            our_params,
+            jnp.asarray(ids),
+            jnp.asarray(mask),
+            TINY,
+            pooling="mean",
+            normalize=True,
+        )
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=1e-3)
+
+
+def test_gelu_variant_is_erf_not_tanh(hf_model, our_params):
+    """The two GELUs differ by up to ~3e-3 around |x|~2; with random f32
+    weights through 3 layers that compounds well past our atol, so parity
+    above would fail under tanh.  Guard the variant explicitly anyway."""
+    x = jnp.linspace(-4, 4, 101)
+    ours = jax.nn.gelu(x, approximate=False)
+    ref = torch.nn.functional.gelu(torch.linspace(-4, 4, 101)).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=1e-6)
+
+
+# -- tokenizer parity ---------------------------------------------------------
+
+VOCAB = (
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    + ["the", "quick", "brown", "fox", "jump", "##s", "##ed", "over"]
+    + ["lazy", "dog", "un", "##believ", "##able", ",", ".", "!", "?", "'"]
+    + list("abcdefghijklmnopqrstuvwxyz")
+    + ["##" + c for c in "abcdefghijklmnopqrstuvwxyz"]
+)
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    path.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return str(path)
+
+
+TEXTS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "unbelievable!",
+    "Jumped, jumped?  JUMPED",
+    "café naïve",  # accents strip to cafe naive
+    "xyzzyqq unknownword",
+    "",
+    "a " * 100,  # truncation
+]
+
+
+def test_wordpiece_matches_hf_bert_tokenizer(vocab_file):
+    ours = WordPieceTokenizer.from_vocab_file(vocab_file)
+    hf = transformers.BertTokenizer(
+        vocab_file, do_lower_case=True, do_basic_tokenize=True
+    )
+    max_length = 16
+    ids, mask = ours.encode_batch(TEXTS, max_length)
+    ref = hf(
+        TEXTS,
+        padding="max_length",
+        truncation=True,
+        max_length=max_length,
+        return_tensors="np",
+    )
+    np.testing.assert_array_equal(ids, ref["input_ids"].astype(np.int32))
+    np.testing.assert_array_equal(
+        mask, ref["attention_mask"].astype(np.int32)
+    )
+
+
+# -- real checkpoint golden (runs only when assets exist locally) -------------
+
+
+def test_real_bge_checkpoint_golden():
+    """Point LWC_BGE_DIR at an HF-layout bge dir to run the golden check:
+    known sentence -> our embedding vs transformers' embedding, 1e-3.
+
+    Expected layout (standard HF snapshot):
+        $LWC_BGE_DIR/config.json
+        $LWC_BGE_DIR/pytorch_model.bin  (or model.safetensors)
+        $LWC_BGE_DIR/vocab.txt
+    """
+    root = os.environ.get("LWC_BGE_DIR")
+    if not root or not os.path.isdir(root):
+        pytest.skip(
+            "no local bge checkpoint (set LWC_BGE_DIR to an HF snapshot "
+            "dir with config.json + weights + vocab.txt); structural "
+            "parity vs transformers is covered by the tests above"
+        )
+    hf_tok = transformers.BertTokenizer(os.path.join(root, "vocab.txt"))
+    hf = transformers.BertModel.from_pretrained(root, add_pooling_layer=False)
+    hf.eval()
+    cfg = hf.config
+    config = BertConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_hidden_layers,
+        num_heads=cfg.num_attention_heads,
+        intermediate_size=cfg.intermediate_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        type_vocab_size=cfg.type_vocab_size,
+        layer_norm_eps=cfg.layer_norm_eps,
+    )
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = bert.from_hf_weights(state, config)
+    ours_tok = WordPieceTokenizer.from_vocab_file(
+        os.path.join(root, "vocab.txt")
+    )
+    text = "Represent this sentence: weighted consensus on TPU."
+    ids, mask = ours_tok.encode_batch([text], 64)
+    with torch.no_grad():
+        hidden = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state
+        ref = torch.nn.functional.normalize(hidden[:, 0], p=2, dim=-1).numpy()
+    ours = np.asarray(
+        bert.embed(params, jnp.asarray(ids), jnp.asarray(mask), config)
+    )
+    np.testing.assert_allclose(ours, ref, atol=1e-3)

@@ -68,6 +68,10 @@ class InMemoryArchive(Fetcher):
         self._chat: dict = {}
         self._score: dict = {}
         self._multichat: dict = {}
+        # score completion id -> {judge model_index: [(key, candidate)]}:
+        # the archivable ballot form enabling logprob re-extraction
+        # (archive/rescore.py revote; populated via ScoreClient.ballot_sink)
+        self._ballots: dict = {}
 
     def put_chat(self, completion) -> str:
         self._chat[completion.id] = completion
@@ -76,6 +80,18 @@ class InMemoryArchive(Fetcher):
     def put_score(self, completion) -> str:
         self._score[completion.id] = completion
         return completion.id
+
+    def put_ballot(
+        self, completion_id: str, judge_index: int, key_indices: list
+    ) -> None:
+        """ScoreClient.ballot_sink-shaped recorder:
+        ``ScoreClient(..., ballot_sink=store.put_ballot)``."""
+        self._ballots.setdefault(completion_id, {})[judge_index] = list(
+            key_indices
+        )
+
+    def score_ballots(self, completion_id: str) -> Optional[dict]:
+        return self._ballots.get(completion_id)
 
     def put_multichat(self, completion) -> str:
         self._multichat[completion.id] = completion

@@ -150,6 +150,29 @@ class PrefixTree:
             raise ValueError("prefix tree walk ended on a leaf")
         return node
 
+    # -- reconstruction from flattened records -------------------------------
+
+    @staticmethod
+    def leaf_branch_of(key_indices: list, key: str) -> dict:
+        """Reconstruct the leaf branch containing ``key`` from a flattened
+        ``(key, candidate_index)`` record (the archivable form of a ballot):
+        all keys sharing ``key``'s letter prefix, mapped final-letter ->
+        candidate.  This is what archive re-extraction stores instead of
+        the full tree (archive/rescore.py).
+
+        Comparison is over ALPHABET letter sequences, not raw strings, so a
+        tick-stripped match from ``find_key`` ("C``B" for stored "`C``B`")
+        still resolves — mirroring ``walk``, which also consumes only
+        alphabet letters.
+        """
+        letters = [c for c in key if c in ALPHABET]
+        branch: dict = {}
+        for k, idx in key_indices:
+            kl = [c for c in k if c in ALPHABET]
+            if len(kl) == len(letters) and kl[:-1] == letters[:-1]:
+                branch[kl[-1]] = idx
+        return branch
+
     # -- regex patterns (client.rs:1605-1630) -------------------------------
 
     @staticmethod

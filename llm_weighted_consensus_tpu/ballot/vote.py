@@ -47,6 +47,28 @@ def _char_at_byte(token: str, byte_index: int) -> Optional[str]:
     return None
 
 
+def find_key(
+    content: Optional[str],
+    with_ticks_pattern: str,
+    without_ticks_pattern: str,
+) -> Optional[str]:
+    """Last ballot-key occurrence in ``content``: models often restate keys
+    while reasoning, the final statement is the decision
+    (client.rs:1675-1688).  Backticked match preferred, tick-stripped
+    fallback."""
+    if not content:
+        return None
+    key = _last_match(with_ticks_pattern, content)
+    if key is None:
+        key = _last_match(without_ticks_pattern, content)
+    return key
+
+
+def final_letter(key: str) -> str:
+    """The key's final alphabet letter — selects within the lowest branch."""
+    return next(c for c in reversed(key) if c in ALPHABET)
+
+
 def extract_vote(
     tree: PrefixTree,
     with_ticks_pattern: str,
@@ -68,16 +90,11 @@ def extract_vote(
     if not content:
         raise InvalidContentError("judge output is empty")
 
-    # last occurrence wins: models often restate keys while reasoning, the
-    # final statement is the decision (client.rs:1675-1688)
-    key = _last_match(with_ticks_pattern, content)
-    if key is None:
-        key = _last_match(without_ticks_pattern, content)
+    key = find_key(content, with_ticks_pattern, without_ticks_pattern)
     if key is None:
         raise InvalidContentError("no ballot key found in judge output")
 
-    # final alphabet letter of the key selects within the lowest branch
-    final_char = next(c for c in reversed(key) if c in ALPHABET)
+    final_char = final_letter(key)
 
     branch = tree.walk(key)
 
@@ -95,20 +112,19 @@ def extract_vote(
     return vote
 
 
-def _soft_vote(
-    branch: dict,
-    key: str,
-    final_char: str,
-    vote: list,
-    logprob_tokens: Optional[list],
-) -> Optional[list]:
-    """Logprob soft-vote path (client.rs:1721-1792); None -> fall back to one-hot."""
+def align_key_token(
+    key: str, final_char: str, logprob_tokens: Optional[list]
+):
+    """Reverse-align ``key`` against the token stream to find the token that
+    carries the final key letter (client.rs:1721-1762).  Multi-char tokens,
+    split keys, and unicode are all handled by byte-offset matching.
+
+    Returns ``(entry, byte_index)`` — the logprob entry and the UTF-8 byte
+    offset of the final letter inside its token — or None when the key is
+    not alignable (missing/partial logprobs)."""
     if not logprob_tokens:
         return None
 
-    # Reverse-align the key against the token stream to find the token that
-    # carries the final key letter.  Multi-char tokens, split keys, and
-    # unicode are all handled by byte-offset matching.
     key_rev = key[::-1]
     remaining = key_rev
     key_token = None
@@ -141,8 +157,18 @@ def _soft_vote(
 
     if remaining or key_token is None:
         return None
+    return key_token, key_byte_index
 
-    total = Decimal(0)
+
+def soft_vote_alternatives(
+    branch: dict, key_token, key_byte_index: int
+) -> list:
+    """The ``top_logprobs`` alternatives of the aligned key token that map
+    to sibling leaves: list of (candidate_index, raw logprob).  This is
+    the input both vote paths share — the host path exp/normalizes it in
+    Decimal below, the device path (ops.votes.softmax_votes) in f32 as one
+    batched kernel (archive re-extraction, SURVEY §3.5 hot loop #2)."""
+    out = []
     for alt in getattr(key_token, "top_logprobs", None) or []:
         token = getattr(alt, "token", None)
         logprob = getattr(alt, "logprob", None)
@@ -154,6 +180,27 @@ def _soft_vote(
         leaf = branch.get(c)
         if not isinstance(leaf, int):
             continue
+        out.append((leaf, logprob))
+    return out
+
+
+def _soft_vote(
+    branch: dict,
+    key: str,
+    final_char: str,
+    vote: list,
+    logprob_tokens: Optional[list],
+) -> Optional[list]:
+    """Logprob soft-vote path (client.rs:1721-1792); None -> fall back to one-hot."""
+    aligned = align_key_token(key, final_char, logprob_tokens)
+    if aligned is None:
+        return None
+    key_token, key_byte_index = aligned
+
+    total = Decimal(0)
+    for leaf, logprob in soft_vote_alternatives(
+        branch, key_token, key_byte_index
+    ):
         p = Decimal(str(logprob)).exp()
         vote[leaf] += p
         total += p

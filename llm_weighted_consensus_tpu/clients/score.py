@@ -370,12 +370,17 @@ class ScoreClient:
         weight_fetchers: Optional[WeightFetchers] = None,
         archive_fetcher: Optional[archive_mod.Fetcher] = None,
         rng_factory=random.Random,
+        ballot_sink=None,
     ) -> None:
         self.chat_client = chat_client
         self.model_fetcher = model_fetcher
         self.weight_fetchers = weight_fetchers or WeightFetchers()
         self.archive_fetcher = archive_fetcher or archive_mod.UnimplementedFetcher()
         self.rng_factory = rng_factory
+        # optional callback(response_id, judge_index, key_indices): archives
+        # the per-judge ballot assignment so stored logprobs can be
+        # re-extracted into soft votes later (archive/rescore.py revote)
+        self.ballot_sink = ballot_sink
 
     # -- unary (client.rs:71-91) --------------------------------------------
 
@@ -598,6 +603,8 @@ class ScoreClient:
         keys = [k for k, _ in key_indices]
         ballot_json = serialize_ballot(request.choices, key_indices)
         with_ticks, without_ticks = PrefixTree.regex_patterns(keys)
+        if self.ballot_sink is not None:
+            self.ballot_sink(resp_id, llm.index, list(key_indices))
 
         chat_params = self._judge_chat_params(
             llm, request, ballot_json, keys

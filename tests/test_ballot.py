@@ -285,3 +285,33 @@ def test_unicode_in_stream():
     key, idx = pairs[0]
     vote = extract_vote(tree, wt, wo, 2, f"café ✓ — choosing {key} ✓")
     assert vote[idx] == Decimal(1)
+
+
+def test_leaf_branch_of_matches_tree_walk():
+    """The flattened (key, candidate) record reconstructs every leaf branch
+    exactly — the invariant archive revote relies on."""
+    import random
+
+    from llm_weighted_consensus_tpu.ballot import PrefixTree
+
+    for n, limit in [(2, 20), (20, 20), (21, 20), (9, 2), (400, 20)]:
+        tree = PrefixTree.build(random.Random(5), n, limit)
+        pairs = tree.key_indices(random.Random(6))
+        for key, idx in pairs:
+            branch = PrefixTree.leaf_branch_of(pairs, key)
+            assert branch == tree.walk(key), (n, limit, key)
+            assert branch[key[-2]] == idx
+
+
+def test_leaf_branch_of_matches_walk_for_stripped_keys():
+    import random
+
+    from llm_weighted_consensus_tpu.ballot import PrefixTree
+
+    for n, limit in [(2, 20), (9, 2), (21, 20)]:
+        tree = PrefixTree.build(random.Random(5), n, limit)
+        pairs = tree.key_indices(random.Random(6))
+        for key, idx in pairs:
+            stripped = key[1:-1]  # find_key's without_ticks form
+            branch = PrefixTree.leaf_branch_of(pairs, stripped)
+            assert branch == tree.walk(key), (n, limit, key)

@@ -198,3 +198,148 @@ def test_archive_rescore_mesh_10k_shape():
     _, conf = rescore_batch(votes, weights, mesh=mesh)
     assert conf.shape == (b, n)
     np.testing.assert_allclose(np.asarray(conf).sum(axis=1), 1.0, atol=1e-5)
+
+
+# -- device soft-vote re-extraction (revote) ----------------------------------
+
+
+def _soft_vote_archive(p0=0.7, seed=13):
+    """One-judge (top_logprobs=2) score completion archived WITH its ballot:
+    the judge's key token carries a {p0, 1-p0} top_logprobs distribution
+    over the two sibling letters."""
+    import math
+    import random
+
+    from llm_weighted_consensus_tpu import archive, registry
+    from llm_weighted_consensus_tpu.ballot import PrefixTree
+    from llm_weighted_consensus_tpu.ballot.tree import branch_limit
+    from llm_weighted_consensus_tpu.clients.chat import (
+        ApiBase,
+        BackoffPolicy,
+        DefaultChatClient,
+    )
+    from llm_weighted_consensus_tpu.clients.score import ScoreClient
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from fakes import FakeTransport, Script, chunk_obj
+
+    rng = random.Random(seed)
+    tree = PrefixTree.build(rng, 2, branch_limit(2))
+    pairs = tree.key_indices(rng)
+    key0 = pairs[0][0]
+    branch = tree.walk(key0)
+    letters = list(branch)
+    lp = {
+        "content": [
+            {"token": "`", "logprob": -0.01, "top_logprobs": []},
+            {
+                "token": key0[1],
+                "logprob": math.log(p0),
+                "top_logprobs": [
+                    {"token": letters[0], "logprob": math.log(p0)},
+                    {"token": letters[1], "logprob": math.log(1 - p0)},
+                ],
+            },
+            {"token": "`", "logprob": -0.01, "top_logprobs": []},
+        ]
+    }
+    transport = FakeTransport(
+        [Script([chunk_obj(key0, finish="stop", logprobs=lp)])]
+    )
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")],
+        backoff=BackoffPolicy(max_elapsed_ms=0),
+    )
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat, registry.InMemoryModelRegistry(), archive_fetcher=store,
+        rng_factory=lambda: random.Random(seed),
+        ballot_sink=store.put_ballot,
+    )
+    result = go(
+        score.create_unary(
+            None,
+            ScoreParams.from_json_obj(
+                {
+                    "messages": [{"role": "user", "content": "q"}],
+                    "model": {
+                        "llms": [
+                            {
+                                "model": "judge-a",
+                                "top_logprobs": 2,
+                                "weight": {"type": "static", "weight": 1},
+                            }
+                        ]
+                    },
+                    "choices": ["a", "b"],
+                }
+            ),
+        )
+    )
+    store.put_score(result)
+    return store, result, branch, letters
+
+
+def test_revote_matches_host_decimal_extraction():
+    """Device re-extraction (softmax_votes over stored logprobs) must agree
+    with the live host Decimal path that produced the stored votes."""
+    from llm_weighted_consensus_tpu.archive.rescore import rescore_archive
+
+    store, result, branch, letters = _soft_vote_archive(p0=0.7)
+    judge = [c for c in result.choices if c.index >= 2][0]
+    host_vote = [float(v) for v in judge.message.vote]
+    assert sum(host_vote) == pytest.approx(1.0)
+
+    results = rescore_archive(store, revote=True)
+    conf = [float(x) for x in results[result.id]["confidence"]]
+    np.testing.assert_allclose(conf, host_vote, atol=1e-6)
+
+
+def test_revote_recomputes_from_tampered_logprobs():
+    """revote re-derives votes from logprobs — it must track a changed
+    distribution while the stored-vote path keeps the old one."""
+    from llm_weighted_consensus_tpu.archive.rescore import rescore_archive
+
+    store, result, branch, letters = _soft_vote_archive(p0=0.7)
+    import math
+    from decimal import Decimal
+
+    judge = [c for c in result.choices if c.index >= 2][0]
+    alts = judge.logprobs.content[1].top_logprobs
+    alts[0].logprob = Decimal(str(math.log(0.2)))
+    alts[1].logprob = Decimal(str(math.log(0.8)))
+
+    stale = rescore_archive(store, revote=False)[result.id]["confidence"]
+    fresh = rescore_archive(store, revote=True)[result.id]["confidence"]
+    i0, i1 = branch[letters[0]], branch[letters[1]]
+    assert float(stale[i0]) == pytest.approx(0.7, abs=1e-6)
+    assert float(fresh[i0]) == pytest.approx(0.2, abs=1e-6)
+    assert float(fresh[i1]) == pytest.approx(0.8, abs=1e-6)
+
+
+def test_revote_without_ballots_falls_back_to_stored_votes():
+    from llm_weighted_consensus_tpu.archive.rescore import rescore_archive
+
+    store, result, *_ = _soft_vote_archive(p0=0.6)
+    store._ballots.clear()  # simulate an archive without ballot records
+    with_stored = rescore_archive(store, revote=False)[result.id]
+    fallback = rescore_archive(store, revote=True)[result.id]
+    assert [float(x) for x in fallback["confidence"]] == pytest.approx(
+        [float(x) for x in with_stored["confidence"]]
+    )
+
+
+def test_revote_handles_tick_stripped_content():
+    """A judge that answered without backtick quoting still re-extracts:
+    find_key returns the stripped key and leaf_branch_of matches it by
+    letter sequence (as the live tree.walk does)."""
+    from llm_weighted_consensus_tpu.archive.rescore import rescore_archive
+
+    store, result, branch, letters = _soft_vote_archive(p0=0.7)
+    judge = [c for c in result.choices if c.index >= 2][0]
+    judge.message.content = judge.message.content.replace("`", "")
+    host_vote = [float(v) for v in judge.message.vote]
+
+    results = rescore_archive(store, revote=True)
+    conf = [float(x) for x in results[result.id]["confidence"]]
+    np.testing.assert_allclose(conf, host_vote, atol=1e-6)

@@ -139,6 +139,13 @@ def main() -> int:
     parser.add_argument("--requests", type=int, default=100)
     parser.add_argument("--latency-requests", type=int, default=50)
     parser.add_argument("--no-pipeline", action="store_true")
+    parser.add_argument(
+        "--profile",
+        metavar="DIR",
+        default=None,
+        help="dump a JAX profiler (xprof) trace of the throughput loop "
+        "under DIR",
+    )
     args = parser.parse_args()
 
     import jax
@@ -172,6 +179,8 @@ def main() -> int:
     # throughput: async dispatch + overlapped fetches (the serving shape);
     # --no-pipeline is the strictly-serial baseline (fetch before the next
     # dispatch, nothing overlapped)
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
     t_start = time.perf_counter()
     if args.no_pipeline:
         results = [np.asarray(consensus(texts)) for texts in requests]
@@ -186,6 +195,8 @@ def main() -> int:
         results = [f.result() for f in futures]
         fetch_pool.shutdown()
     total = time.perf_counter() - t_start
+    if args.profile:
+        jax.profiler.stop_trace()
     for r in results:
         assert abs(float(np.sum(r)) - 1.0) < 1e-2
 

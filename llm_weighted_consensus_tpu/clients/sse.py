@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 from typing import Iterator, Optional
 
 
@@ -87,48 +86,30 @@ class SSEParser:
 
 # -- native twin --------------------------------------------------------------
 
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-)
-_NATIVE_SO = os.path.join(_NATIVE_DIR, "liblwc_native.so")
 _native_lib = None
 _native_tried = False
 
 
 def load_native_library():
-    """The C++ parser's shared library, compiled on first call.  Blocking —
-    call it from sync startup code (DefaultChatClient.__init__ does), never
-    from the event loop; ``make_parser`` afterwards only reads the cache.
-    The compile goes to a temp file then ``os.replace`` so concurrent
-    builders can't hand anyone a truncated .so (and processes that already
-    mapped the old inode keep it).  Returns None — and remembers the
-    failure — when the library can't be built or loaded, or when
-    ``LWC_NATIVE_SSE=0``."""
+    """The C++ parser out of the framework-wide native library
+    (utils.native builds/loads the single .so for all native components).
+    Blocking on first call — call from sync startup code
+    (DefaultChatClient.__init__ does), never from the event loop;
+    ``make_parser`` afterwards only reads the cache.  Returns None — and
+    remembers the failure — when the library can't be built or loaded, or
+    when ``LWC_NATIVE_SSE=0``."""
     global _native_lib, _native_tried
     if _native_tried:
         return _native_lib
     _native_tried = True
     if os.environ.get("LWC_NATIVE_SSE", "1").lower() in ("0", "false", "no"):
         return None
+    from ..utils.native import load_library
+
+    lib = load_library()
+    if lib is None:
+        return None
     try:
-        src = os.path.join(_NATIVE_DIR, "sse_parser.cpp")
-        if not os.path.exists(_NATIVE_SO) or (
-            os.path.exists(src)
-            and os.path.getmtime(src) > os.path.getmtime(_NATIVE_SO)
-        ):
-            tmp = f"{_NATIVE_SO}.tmp.{os.getpid()}"
-            subprocess.run(
-                [
-                    "g++", "-O3", "-std=c++17", "-fPIC", "-Wall",
-                    "-shared", "-o", tmp, src,
-                ],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-            os.replace(tmp, _NATIVE_SO)
-        lib = ctypes.CDLL(_NATIVE_SO)
         lib.sse_parser_new.restype = ctypes.c_void_p
         lib.sse_parser_new.argtypes = []
         lib.sse_parser_free.argtypes = [ctypes.c_void_p]

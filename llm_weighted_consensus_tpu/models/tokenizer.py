@@ -79,21 +79,42 @@ def basic_tokenize(text: str) -> list:
 
 
 class WordPieceTokenizer(BaseTokenizer):
-    def __init__(self, vocab: dict, max_chars_per_word: int = 100):
+    """WordPiece with a native (C++) ASCII fast path.
+
+    Pure-ASCII texts — the English serving hot case — encode through
+    ``native/wordpiece.cpp`` when the native library loads (byte-for-byte
+    parity, tests/test_native.py); non-ASCII texts take the Python path,
+    which owns the Unicode NFD + combining-mark handling.  ``use_native=
+    False`` forces Python everywhere.
+    """
+
+    def __init__(
+        self,
+        vocab: dict,
+        max_chars_per_word: int = 100,
+        use_native: bool = True,
+    ):
         self.vocab = vocab
         self.max_chars_per_word = max_chars_per_word
         self.pad_id = vocab[PAD]
         self.cls_id = vocab[CLS]
         self.sep_id = vocab[SEP]
         self.unk_id = vocab[UNK]
+        # the C++ path hardcodes the default word-length cap; any custom
+        # cap keeps the Python path
+        self._native = (
+            _native_wordpiece(vocab)
+            if use_native and max_chars_per_word == 100
+            else None
+        )
 
     @classmethod
-    def from_vocab_file(cls, path: str) -> "WordPieceTokenizer":
+    def from_vocab_file(cls, path: str, **kwargs) -> "WordPieceTokenizer":
         vocab = {}
         with open(path, encoding="utf-8") as f:
             for i, line in enumerate(f):
                 vocab[line.rstrip("\r\n")] = i
-        return cls(vocab)
+        return cls(vocab, **kwargs)
 
     def _wordpiece(self, word: str) -> list:
         if len(word) > self.max_chars_per_word:
@@ -119,6 +140,10 @@ class WordPieceTokenizer(BaseTokenizer):
         return pieces
 
     def _encode(self, text: str, max_length: int):
+        if self._native is not None and text.isascii():
+            out = self._native.encode(text, max_length)
+            if out is not None:
+                return out
         ids = [self.cls_id]
         for word in basic_tokenize(text):
             ids.extend(self._wordpiece(word))
@@ -127,6 +152,82 @@ class WordPieceTokenizer(BaseTokenizer):
         ids = ids[: max_length - 1]
         ids.append(self.sep_id)
         return ids
+
+
+class _NativeWordPiece:
+    """ctypes bridge to native/wordpiece.cpp (ASCII fast path)."""
+
+    def __init__(self, lib, vocab_blob: bytes):
+        import ctypes
+
+        lib.wp_new.restype = ctypes.c_void_p
+        lib.wp_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.wp_free.argtypes = [ctypes.c_void_p]
+        lib.wp_encode.restype = ctypes.c_int64
+        lib.wp_encode.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        self._ctypes = ctypes
+        self._lib = lib
+        self._handle = lib.wp_new(vocab_blob, len(vocab_blob))
+        if not self._handle:
+            raise ValueError("native wordpiece rejected the vocab")
+
+    def encode(self, text: str, max_length: int):
+        # fresh output buffer per call: wp_encode releases the GIL, and the
+        # gateway encodes on executor threads — a shared buffer would race
+        # under concurrent /embeddings requests
+        buf = (self._ctypes.c_int32 * max_length)()
+        raw = text.encode("ascii")
+        n = self._lib.wp_encode(self._handle, raw, len(raw), max_length, buf)
+        if n < 0:
+            return None
+        return list(buf[: int(n)])
+
+    def __del__(self):
+        try:
+            if self._handle:
+                self._lib.wp_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+def _native_wordpiece(vocab: dict):
+    """A ``_NativeWordPiece`` for this vocab, or None when the native
+    library is unavailable or the vocab can't be serialized (ids must be
+    exactly 0..n-1, tokens newline-free, specials present)."""
+    try:
+        from ..utils.native import load_library
+
+        lib = load_library()
+        if lib is None:
+            return None
+        n = len(vocab)
+        lines = [None] * n
+        for token, i in vocab.items():
+            if (
+                not isinstance(i, int)
+                or not 0 <= i < n
+                or lines[i] is not None
+                or "\n" in token
+                or "\r" in token
+            ):
+                return None
+            lines[i] = token
+        if any(line is None for line in lines):
+            return None
+        for special in (CLS, SEP, UNK):
+            if special not in vocab:
+                return None
+        blob = ("\n".join(lines) + "\n").encode("utf-8")
+        return _NativeWordPiece(lib, blob)
+    except Exception:
+        return None
 
 
 class HashTokenizer(BaseTokenizer):

@@ -151,7 +151,13 @@ def build_service(config: Config, fake_upstream: bool = False):
     multichat_client = MultichatClient(
         chat_client, model_registry, archive_fetcher=store
     )
-    return build_app(chat_client, score_client, multichat_client, embedder)
+    return build_app(
+        chat_client,
+        score_client,
+        multichat_client,
+        embedder,
+        profile_dir=config.profile_dir,
+    )
 
 
 async def _serve(config: Config, fake_upstream: bool) -> None:

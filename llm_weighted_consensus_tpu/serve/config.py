@@ -17,6 +17,9 @@ TPU additions:
   empty + ``MESH_TP=n`` uses every device not consumed by tp for dp.
 * ``MULTIHOST`` — set to 1 on each host of a multi-host slice to call
   ``jax.distributed.initialize`` before mesh construction (parallel/dist.py).
+* ``PROFILE_DIR`` — arms ``POST /profile/start`` / ``POST /profile/stop``:
+  JAX profiler traces (xprof format, viewable in TensorBoard/xprof) are
+  written under this directory.  Unset = endpoints disabled (404).
 """
 
 from __future__ import annotations
@@ -74,6 +77,7 @@ class Config:
     embedder_max_tokens: int = 512
     mesh_dp: Optional[int] = None
     mesh_tp: int = 1
+    profile_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -122,6 +126,7 @@ class Config:
             embedder_max_tokens=int(env.get("EMBEDDER_MAX_TOKENS", 512)),
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
             mesh_tp=int(env.get("MESH_TP", 1)),
+            profile_dir=env.get("PROFILE_DIR"),
         )
 
     def backoff_policy(self):

@@ -148,12 +148,52 @@ def _multichat_streaming(multichat_client, embedder, metrics):
     return create_streaming
 
 
+def _profile_handlers(profile_dir: str):
+    """JAX profiler control (SURVEY §5 tracing row): traces land under
+    ``profile_dir`` in xprof format.  One trace at a time; stop without
+    start is a 400 rather than a crash."""
+    import asyncio
+
+    state = {"active": False}
+
+    async def start(request: web.Request):
+        import jax
+
+        if state["active"]:
+            return web.json_response(
+                {"code": 400, "message": "trace already active"}, status=400
+            )
+        # profiler start/stop do real IO; keep the loop serving streams
+        await asyncio.get_running_loop().run_in_executor(
+            None, jax.profiler.start_trace, profile_dir
+        )
+        state["active"] = True
+        return web.json_response({"ok": True, "dir": profile_dir})
+
+    async def stop(request: web.Request):
+        import jax
+
+        if not state["active"]:
+            return web.json_response(
+                {"code": 400, "message": "no active trace"}, status=400
+            )
+        # trace serialization can be hundreds of MB — never on the loop
+        await asyncio.get_running_loop().run_in_executor(
+            None, jax.profiler.stop_trace
+        )
+        state["active"] = False
+        return web.json_response({"ok": True, "dir": profile_dir})
+
+    return start, stop
+
+
 def build_app(
     chat_client,
     score_client,
     multichat_client=None,
     embedder=None,
     metrics=None,
+    profile_dir=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
     app = web.Application(middlewares=[middleware(metrics)])
@@ -196,6 +236,10 @@ def build_app(
 
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics_handler)
+    if profile_dir:
+        start, stop = _profile_handlers(profile_dir)
+        app.router.add_post("/profile/start", start)
+        app.router.add_post("/profile/stop", stop)
     return app
 
 

@@ -647,3 +647,51 @@ def test_metrics_unmatched_paths_bucket_together():
         assert not any("nope" in k for k in series)
 
     go(with_client(app, run))
+
+
+def test_profile_endpoints(tmp_path):
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    embedder = TpuEmbedder("test-tiny")
+    transport = FakeTransport([])
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    reg = registry.InMemoryModelRegistry()
+    store = archive.InMemoryArchive()
+    score = ScoreClient(chat, reg, archive_fetcher=store)
+    prof_dir = str(tmp_path / "traces")
+    app = build_app(chat, score, None, embedder, profile_dir=prof_dir)
+
+    async def run(client):
+        # traced request between start and stop
+        assert (await client.post("/profile/start")).status == 200
+        # double start is a clean 400
+        assert (await client.post("/profile/start")).status == 400
+        resp = await client.post(
+            "/embeddings", json={"model": "test-tiny", "input": ["trace me"]}
+        )
+        assert resp.status == 200
+        assert (await client.post("/profile/stop")).status == 200
+        assert (await client.post("/profile/stop")).status == 400
+        # a trace landed on disk
+        import os
+
+        found = [
+            os.path.join(r, f)
+            for r, _, fs in os.walk(prof_dir)
+            for f in fs
+        ]
+        assert found, "no trace files written"
+
+    go(with_client(app, run))
+
+
+def test_profile_endpoints_absent_without_config():
+    app, _ = make_app([])
+
+    async def run(client):
+        assert (await client.post("/profile/start")).status == 404
+
+    go(with_client(app, run))

@@ -205,3 +205,114 @@ def test_native_parser_is_on_the_chat_client_path(native_lib):
     src = inspect.getsource(chat)
     assert "make_parser()" in src
     assert isinstance(sse.make_parser(), sse.NativeSSEParser)
+
+
+# -- native WordPiece (ASCII fast path) ---------------------------------------
+
+WP_VOCAB = (
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    + ["the", "quick", "brown", "fox", "jump", "##s", "##ed", "over"]
+    + ["lazy", "dog", "un", "##believ", "##able", ",", ".", "!", "?"]
+    + list("abcdefghijklmnopqrstuvwxyz")
+    + ["##" + c for c in "abcdefghijklmnopqrstuvwxyz"]
+)
+
+WP_TEXTS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "unbelievable!",
+    "Jumped, jumped?  JUMPED",
+    "tabs\tand\nnewlines",
+    "xq" * 60,  # > max word chars -> [UNK]
+    "",
+    "a " * 200,  # truncation
+    "punct,,,!!chains..",
+]
+
+
+def _wp(use_native):
+    from llm_weighted_consensus_tpu.models.tokenizer import WordPieceTokenizer
+
+    # dedupe ("##s" appears in both the word list and the letter pieces)
+    # so ids stay contiguous — the native bridge requires ids 0..n-1
+    vocab = {
+        token: i for i, token in enumerate(dict.fromkeys(WP_VOCAB))
+    }
+    return WordPieceTokenizer(vocab, use_native=use_native)
+
+
+@pytest.fixture(scope="module")
+def native_wp():
+    wp = _wp(use_native=True)
+    if wp._native is None:
+        pytest.skip("native wordpiece not buildable here")
+    return wp
+
+
+def test_native_wordpiece_matches_python(native_wp):
+    python = _wp(use_native=False)
+    for max_len in (8, 16, 64):
+        ids_n, mask_n = native_wp.encode_batch(WP_TEXTS, max_len)
+        ids_p, mask_p = python.encode_batch(WP_TEXTS, max_len)
+        assert ids_n.tolist() == ids_p.tolist(), max_len
+        assert mask_n.tolist() == mask_p.tolist()
+
+
+def test_native_wordpiece_random_ascii_parity(native_wp):
+    import random
+    import string
+
+    python = _wp(use_native=False)
+    rng = random.Random(3)
+    chars = string.ascii_letters + string.punctuation + " \t"
+    texts = [
+        "".join(rng.choice(chars) for _ in range(rng.randint(0, 80)))
+        for _ in range(200)
+    ]
+    ids_n, _ = native_wp.encode_batch(texts, 32)
+    ids_p, _ = python.encode_batch(texts, 32)
+    assert ids_n.tolist() == ids_p.tolist()
+
+
+def test_non_ascii_falls_back_to_python_path(native_wp):
+    python = _wp(use_native=False)
+    texts = ["café naïve voilà", "Ünïcödé everywhere", "mixed ascii café"]
+    ids_n, _ = native_wp.encode_batch(texts, 16)
+    ids_p, _ = python.encode_batch(texts, 16)
+    assert ids_n.tolist() == ids_p.tolist()
+
+
+def test_ascii_control_chars_parity(native_wp):
+    """\\x1c-\\x1f are whitespace to Python's str.isspace(): the native
+    path must split on them too."""
+    python = _wp(use_native=False)
+    texts = ["a\x1cb", "the\x1dquick", "fox\x1e\x1fdog", "a\x0bb\x0cc"]
+    ids_n, _ = native_wp.encode_batch(texts, 16)
+    ids_p, _ = python.encode_batch(texts, 16)
+    assert ids_n.tolist() == ids_p.tolist()
+
+
+def test_native_wordpiece_thread_safety(native_wp):
+    """wp_encode releases the GIL; concurrent encodes (the gateway's
+    executor shape) must not corrupt each other's output."""
+    import random
+    from concurrent.futures import ThreadPoolExecutor
+
+    python = _wp(use_native=False)
+    rng = random.Random(9)
+    words = ["the", "quick", "brown", "fox", "unbelievable", "dog!"]
+    texts = [
+        " ".join(rng.choice(words) for _ in range(rng.randint(1, 40)))
+        for _ in range(200)
+    ]
+    lengths = [8 + (i % 5) * 24 for i in range(len(texts))]
+    expected = [
+        python._encode(t, n) for t, n in zip(texts, lengths)
+    ]
+    with ThreadPoolExecutor(8) as pool:
+        got = list(
+            pool.map(
+                lambda tn: native_wp._encode(tn[0], tn[1]),
+                zip(texts, lengths),
+            )
+        )
+    assert got == expected

@@ -137,15 +137,24 @@ def _use_fused_attention(config: BertConfig, s: int, hd: int) -> bool:
     return jax.default_backend() == "tpu" and s >= 256
 
 
+def _gelu_erf(x: jax.Array) -> jax.Array:
+    """Exact (erf) GELU: HF BERT/bge checkpoints use hidden_act="gelu",
+    which is erf-based — jax.nn.gelu's default tanh approximation would
+    silently diverge from real checkpoints (tests/test_hf_parity.py).
+
+    Computed in f32: XLA's *bf16* erf lowering is ~7x slower on TPU than
+    the f32 one (measured on v5e: 41 ms vs 11 ms for 24 layers of
+    [8192, 4096]; tanh-approx is 6.4 ms), so upcast-erf-downcast is both
+    exact and nearly free relative to in-dtype erf."""
+    x32 = x.astype(jnp.float32)
+    out = x32 * 0.5 * (1.0 + jax.lax.erf(x32 * (2.0 ** -0.5)))
+    return out.astype(x.dtype)
+
+
 def _layer(x, p, mask_bias, config: BertConfig):
     attn = _attention(x, p, mask_bias, config)
     x = _layer_norm(x + attn, p["attn_ln"], config.layer_norm_eps)
-    # exact (erf) GELU: HF BERT/bge checkpoints use hidden_act="gelu",
-    # which is erf-based — jax.nn.gelu's default tanh approximation would
-    # silently diverge from real checkpoints (tests/test_hf_parity.py)
-    mlp = _dense(
-        jax.nn.gelu(_dense(x, p["mlp_in"]), approximate=False), p["mlp_out"]
-    )
+    mlp = _dense(_gelu_erf(_dense(x, p["mlp_in"])), p["mlp_out"])
     return _layer_norm(x + mlp, p["mlp_ln"], config.layer_norm_eps)
 
 

@@ -159,15 +159,21 @@ def _profile_handlers(profile_dir: str):
     async def start(request: web.Request):
         import jax
 
+        # flip state BEFORE the await: a concurrent second start must see
+        # active and get the clean 400, not race into the profiler
         if state["active"]:
             return web.json_response(
                 {"code": 400, "message": "trace already active"}, status=400
             )
-        # profiler start/stop do real IO; keep the loop serving streams
-        await asyncio.get_running_loop().run_in_executor(
-            None, jax.profiler.start_trace, profile_dir
-        )
         state["active"] = True
+        try:
+            # profiler start/stop do real IO; keep the loop serving streams
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.start_trace, profile_dir
+            )
+        except Exception as e:
+            state["active"] = False
+            return _error_response(e)
         return web.json_response({"ok": True, "dir": profile_dir})
 
     async def stop(request: web.Request):
@@ -177,11 +183,16 @@ def _profile_handlers(profile_dir: str):
             return web.json_response(
                 {"code": 400, "message": "no active trace"}, status=400
             )
-        # trace serialization can be hundreds of MB — never on the loop
-        await asyncio.get_running_loop().run_in_executor(
-            None, jax.profiler.stop_trace
-        )
+        # cleared up front so a failed serialization can't wedge the
+        # endpoints until restart; the error still surfaces to the caller
         state["active"] = False
+        try:
+            # trace serialization can be hundreds of MB — never on the loop
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.stop_trace
+            )
+        except Exception as e:
+            return _error_response(e)
         return web.json_response({"ok": True, "dir": profile_dir})
 
     return start, stop

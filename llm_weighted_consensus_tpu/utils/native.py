@@ -51,9 +51,9 @@ def load_library() -> Optional[ctypes.CDLL]:
         return None
     try:
         sources = _sources()
-        if not sources:
-            return None
-        if _stale(sources):
+        # no sources (a packaged deployment shipping only the .so) is fine:
+        # load the prebuilt library as-is
+        if sources and _stale(sources):
             tmp = f"{NATIVE_SO}.tmp.{os.getpid()}"
             subprocess.run(
                 [
@@ -65,6 +65,8 @@ def load_library() -> Optional[ctypes.CDLL]:
                 timeout=120,
             )
             os.replace(tmp, NATIVE_SO)
+        if not os.path.exists(NATIVE_SO):
+            return None
         _lib = ctypes.CDLL(NATIVE_SO)
     except Exception:
         _lib = None

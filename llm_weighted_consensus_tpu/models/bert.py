@@ -89,7 +89,16 @@ def _attention(x, p, mask_bias, config: BertConfig):
         k = heads(_dense(x, p["attn_k"]))
         v = heads(_dense(x, p["attn_v"]))
     scale = 1.0 / float(hd) ** 0.5
-    if _use_fused_attention(config, s, hd):
+    if config.attention_impl == "ring":
+        # sequence-parallel ring attention: only valid inside a shard_map
+        # over config.ring_axis (parallel/ring.py::ring_encode sets it up)
+        from ..parallel.ring import ring_attention
+
+        with jax.named_scope("ring_attention"):
+            ctx = ring_attention(
+                q, k, v, mask_bias[:, 0, 0, :], scale, config.ring_axis
+            )
+    elif _use_fused_attention(config, s, hd):
         from ..ops.attention import fused_attention
 
         with jax.named_scope("fused_attention"):
@@ -164,12 +173,27 @@ def encode(
     attention_mask: jax.Array,
     config: BertConfig,
     token_type_ids: Optional[jax.Array] = None,
+    position_offset=0,
 ) -> jax.Array:
-    """input_ids[b, s], attention_mask[b, s] -> hidden[b, s, h]."""
+    """input_ids[b, s], attention_mask[b, s] -> hidden[b, s, h].
+
+    ``position_offset`` shifts the position embeddings — used by the
+    sequence-parallel forward (parallel/ring.py) where each shard holds a
+    slice of the global sequence."""
     b, s = input_ids.shape
+    if (
+        isinstance(position_offset, int)
+        and s + position_offset > config.max_position_embeddings
+    ):
+        # gathers clamp out-of-range indices — fail loudly instead of
+        # silently reusing the last position embedding
+        raise ValueError(
+            f"sequence {s} (+offset {position_offset}) exceeds "
+            f"max_position_embeddings={config.max_position_embeddings}"
+        )
     with jax.named_scope("embeddings"):
         x = params["token_embed"][input_ids]
-        x = x + params["position_embed"][jnp.arange(s)][None, :, :]
+        x = x + params["position_embed"][jnp.arange(s) + position_offset][None, :, :]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = x + params["type_embed"][token_type_ids]

@@ -17,8 +17,11 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     pad_token_id: int = 0
     # "auto" routes to the fused Pallas attention kernel on TPU when the
-    # (s, s) tile fits VMEM; "fused" / "einsum" force one path.
+    # (s, s) tile fits VMEM; "fused" / "einsum" force one path; "ring"
+    # selects sequence-parallel ring attention (only valid inside
+    # parallel/ring.py's shard_map over ``ring_axis``).
     attention_impl: str = "auto"
+    ring_axis: str = "sp"
 
     @property
     def head_dim(self) -> int:

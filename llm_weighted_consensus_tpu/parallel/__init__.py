@@ -15,7 +15,12 @@ TPU-shaped:
   ``psum`` for the global softmax — replacing the reference's host-side
   tally loop with on-device communication;
 * ``batch``     — archive batch re-scoring sharded over ``dp`` (BASELINE
-  config 4).
+  config 4);
+* ``ring``      — sequence/context parallelism: blockwise ring attention
+  over an ``sp`` axis (ppermute k/v rotation + online softmax), making
+  long-context encoders first-class — per-device attention memory is
+  O(s^2/sp^2);
+* ``dist``      — multi-host (DCN) process-group initialization.
 
 No pipeline parallelism (a 12-24 layer encoder has no use for stages) and
 no expert parallelism (no MoE) — by design, stated here per SURVEY §2.8.
@@ -23,4 +28,4 @@ no expert parallelism (no MoE) — by design, stated here per SURVEY §2.8.
 
 from .dist import maybe_initialize_distributed  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
-from . import batch, collectives, sharding  # noqa: F401
+from . import batch, collectives, ring, sharding  # noqa: F401

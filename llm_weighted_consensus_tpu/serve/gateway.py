@@ -154,45 +154,50 @@ def _profile_handlers(profile_dir: str):
     start is a 400 rather than a crash."""
     import asyncio
 
-    state = {"active": False}
+    # one lock serializes start/stop end-to-end: the JAX profiler is a
+    # process-global singleton, so overlapping operations (a start racing
+    # an in-flight stop's serialization) must queue, and a concurrent
+    # duplicate gets the clean 400 once the lock frees
+    state = {"active": False, "lock": asyncio.Lock()}
 
     async def start(request: web.Request):
         import jax
 
-        # flip state BEFORE the await: a concurrent second start must see
-        # active and get the clean 400, not race into the profiler
-        if state["active"]:
-            return web.json_response(
-                {"code": 400, "message": "trace already active"}, status=400
-            )
-        state["active"] = True
-        try:
-            # profiler start/stop do real IO; keep the loop serving streams
-            await asyncio.get_running_loop().run_in_executor(
-                None, jax.profiler.start_trace, profile_dir
-            )
-        except Exception as e:
-            state["active"] = False
-            return _error_response(e)
+        async with state["lock"]:
+            if state["active"]:
+                return web.json_response(
+                    {"code": 400, "message": "trace already active"},
+                    status=400,
+                )
+            try:
+                # profiler IO runs on the executor; the loop keeps serving
+                await asyncio.get_running_loop().run_in_executor(
+                    None, jax.profiler.start_trace, profile_dir
+                )
+            except Exception as e:
+                return _error_response(e)
+            state["active"] = True
         return web.json_response({"ok": True, "dir": profile_dir})
 
     async def stop(request: web.Request):
         import jax
 
-        if not state["active"]:
-            return web.json_response(
-                {"code": 400, "message": "no active trace"}, status=400
-            )
-        # cleared up front so a failed serialization can't wedge the
-        # endpoints until restart; the error still surfaces to the caller
-        state["active"] = False
-        try:
-            # trace serialization can be hundreds of MB — never on the loop
-            await asyncio.get_running_loop().run_in_executor(
-                None, jax.profiler.stop_trace
-            )
-        except Exception as e:
-            return _error_response(e)
+        async with state["lock"]:
+            if not state["active"]:
+                return web.json_response(
+                    {"code": 400, "message": "no active trace"}, status=400
+                )
+            # cleared regardless of outcome so a failed serialization
+            # can't wedge the endpoints; the error still surfaces
+            state["active"] = False
+            try:
+                # trace serialization can be hundreds of MB — never on
+                # the loop
+                await asyncio.get_running_loop().run_in_executor(
+                    None, jax.profiler.stop_trace
+                )
+            except Exception as e:
+                return _error_response(e)
         return web.json_response({"ok": True, "dir": profile_dir})
 
     return start, stop

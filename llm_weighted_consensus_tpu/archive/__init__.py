@@ -81,6 +81,13 @@ class InMemoryArchive(Fetcher):
         self._score[completion.id] = completion
         return completion.id
 
+    # ballots are recorded for EVERY score request (the sink fires inside
+    # create_streaming) but only archived completions keep needing theirs;
+    # cap the table so streaming-heavy services can't grow it unboundedly
+    # (FIFO eviction of the oldest completion's ballots — dicts preserve
+    # insertion order, and in-flight requests are by definition newest)
+    MAX_BALLOT_COMPLETIONS = 4096
+
     def put_ballot(
         self, completion_id: str, judge_index: int, key_indices: list
     ) -> None:
@@ -89,6 +96,15 @@ class InMemoryArchive(Fetcher):
         self._ballots.setdefault(completion_id, {})[judge_index] = list(
             key_indices
         )
+        while len(self._ballots) > self.MAX_BALLOT_COMPLETIONS:
+            # evict oldest-first but never an archived completion's ballots
+            # (those are exactly the ones revote still needs)
+            victim = next(
+                (c for c in self._ballots if c not in self._score), None
+            )
+            if victim is None:
+                victim = next(iter(self._ballots))
+            self._ballots.pop(victim)
 
     def score_ballots(self, completion_id: str) -> Optional[dict]:
         return self._ballots.get(completion_id)
@@ -122,6 +138,69 @@ class InMemoryArchive(Fetcher):
 
     async def fetch_multichat_completion(self, ctx, completion_id: str):
         return await self._get(self._multichat, completion_id)
+
+    # -- disk snapshot (checkpoint/resume, SURVEY §5) -----------------------
+
+    SNAPSHOT_VERSION = 1
+
+    def save(self, path: str) -> None:
+        """Snapshot every table (+ ballot records) to one JSON file.
+        Written atomically (temp + rename); Decimal-exact via jsonutil."""
+        import os
+
+        from ..utils import jsonutil
+
+        obj = {
+            "version": self.SNAPSHOT_VERSION,
+            "chat": {k: v.to_json_obj() for k, v in self._chat.items()},
+            "score": {k: v.to_json_obj() for k, v in self._score.items()},
+            "multichat": {
+                k: v.to_json_obj() for k, v in self._multichat.items()
+            },
+            # ballots for never-archived completions (e.g. streaming
+            # requests whose fold was not stored) would accumulate forever
+            "ballots": {
+                cid: b
+                for cid, b in self._ballots.items()
+                if cid in self._score
+            },
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(jsonutil.dumps(obj))
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "InMemoryArchive":
+        """Rebuild an archive from a :meth:`save` snapshot."""
+        from ..utils import jsonutil
+
+        with open(path, encoding="utf-8") as f:
+            obj = jsonutil.loads(f.read())
+        version = obj.get("version")
+        if version != cls.SNAPSHOT_VERSION:
+            raise ValueError(
+                f"unsupported archive snapshot version {version!r}"
+            )
+        store = cls()
+        store._chat = {
+            k: chat_response.ChatCompletion.from_json_obj(v)
+            for k, v in obj.get("chat", {}).items()
+        }
+        store._score = {
+            k: score_response.ChatCompletion.from_json_obj(v)
+            for k, v in obj.get("score", {}).items()
+        }
+        store._multichat = {
+            k: multichat_response.ChatCompletion.from_json_obj(v)
+            for k, v in obj.get("multichat", {}).items()
+        }
+        # JSON stringifies the judge-index keys; restore them as ints
+        store._ballots = {
+            cid: {int(judge): pairs for judge, pairs in judges.items()}
+            for cid, judges in obj.get("ballots", {}).items()
+        }
+        return store
 
 
 # ---------------------------------------------------------------------------

@@ -154,6 +154,11 @@ class AiohttpTransport(Transport):
             )
         return self._session
 
+    async def close(self) -> None:
+        """Release the shared session (service shutdown hook)."""
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
     async def post_sse(self, url, headers, body) -> TransportResponse:
         session = self._get_session()
         try:

@@ -64,7 +64,7 @@ def ring_attention(
     perm = [(i, (i + 1) % sp) for i in range(sp)]  # ring: shard i -> i+1
 
     k_cur, v_cur, bias_cur = k, v, bias
-    for _ in range(sp):
+    for step in range(sp):
         kf = k_cur.astype(jnp.float32)
         vf = v_cur.astype(jnp.float32)
         # [b, q_loc, k_loc, nh]
@@ -83,11 +83,14 @@ def ring_attention(
         )
         denom = denom * correction + jnp.sum(p, axis=2)
         run_max = new_max
-        # rotate k/v/bias one step around the ring; the DMA overlaps the
-        # next iteration's einsums
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        bias_cur = jax.lax.ppermute(bias_cur, axis_name, perm)
+        if step < sp - 1:
+            # rotate k/v/bias one step around the ring; the DMA overlaps
+            # the next iteration's einsums.  Skipped on the last step —
+            # collectives carry channel ids and are not reliably DCE'd,
+            # so the wasted rotation would cost real ICI traffic.
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            bias_cur = jax.lax.ppermute(bias_cur, axis_name, perm)
 
     ctx = acc / denom[:, :, :, None]
     return ctx.astype(q.dtype)

@@ -25,6 +25,9 @@ from .gateway import build_app
 
 FAKE_PORT = 5990
 
+# the service's archive store, exposed for introspection/tests
+ARCHIVE_KEY: web.AppKey = web.AppKey("archive", object)
+
 
 async def _fake_upstream(request: web.Request) -> web.StreamResponse:
     """A scripted judge provider: finds the ballot in the system prompt and
@@ -117,13 +120,42 @@ def build_embedder(config: Config):
     return embedder
 
 
+class _ArchivingClient:
+    """Wraps a client so every served UNARY completion is archived (its id
+    becomes referenceable by later requests); everything else delegates.
+    Streaming responses are consumed by the HTTP caller chunk-by-chunk and
+    are not teed into the archive — unary-only, by design."""
+
+    def __init__(self, inner, put):
+        self._inner = inner
+        self._put = put
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def create_unary(self, ctx, params):
+        result = await self._inner.create_unary(ctx, params)
+        self._put(result)
+        return result
+
+
 def build_service(config: Config, fake_upstream: bool = False):
+    import os
+
     api_bases = config.api_bases()
     if fake_upstream:
         api_bases = [ApiBase(f"http://127.0.0.1:{FAKE_PORT}/v1", "fake-key")]
-    store = archive.InMemoryArchive()
+    if config.archive_path and os.path.exists(config.archive_path):
+        store = archive.InMemoryArchive.load(config.archive_path)
+    else:
+        store = archive.InMemoryArchive()
+    if config.archive_path:
+        # fail FAST on an unwritable path: the shutdown save is the last
+        # moment we could find out, and by then the archive would be lost
+        store.save(config.archive_path)
+    transport = AiohttpTransport()
     chat_client = DefaultChatClient(
-        AiohttpTransport(),
+        transport,
         api_bases,
         backoff=config.backoff_policy(),
         user_agent=config.openai_user_agent,
@@ -147,17 +179,39 @@ def build_service(config: Config, fake_upstream: bool = False):
         model_registry,
         weight_fetchers=weight_fetchers,
         archive_fetcher=store,
+        # ballots stored alongside enable logprob re-extraction in batch
+        # re-score (archive/rescore.py revote)
+        ballot_sink=store.put_ballot if config.archive_write else None,
     )
     multichat_client = MultichatClient(
         chat_client, model_registry, archive_fetcher=store
     )
-    return build_app(
-        chat_client,
-        score_client,
-        multichat_client,
+    gw_chat, gw_score, gw_multichat = chat_client, score_client, multichat_client
+    if config.archive_write:
+        gw_chat = _ArchivingClient(chat_client, store.put_chat)
+        gw_score = _ArchivingClient(score_client, store.put_score)
+        gw_multichat = _ArchivingClient(multichat_client, store.put_multichat)
+    app = build_app(
+        gw_chat,
+        gw_score,
+        gw_multichat,
         embedder,
         profile_dir=config.profile_dir,
     )
+    app[ARCHIVE_KEY] = store
+    if config.archive_path:
+        path = config.archive_path
+
+        async def _save_archive(app):
+            store.save(path)
+
+        app.on_cleanup.append(_save_archive)
+
+    async def _close_transport(app):
+        await transport.close()
+
+    app.on_cleanup.append(_close_transport)
+    return app
 
 
 async def _serve(config: Config, fake_upstream: bool) -> None:
@@ -173,7 +227,13 @@ async def _serve(config: Config, fake_upstream: bool) -> None:
     await runner.setup()
     await web.TCPSite(runner, config.address, config.port).start()
     print(f"listening on {config.address}:{config.port}", flush=True)
-    await asyncio.Event().wait()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        # run the app's on_cleanup hooks (e.g. the ARCHIVE_PATH snapshot)
+        # on SIGINT/cancellation — without this, graceful shutdown never
+        # fires them in the real service path
+        await runner.cleanup()
 
 
 def main() -> None:

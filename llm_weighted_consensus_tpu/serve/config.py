@@ -20,6 +20,13 @@ TPU additions:
 * ``PROFILE_DIR`` — arms ``POST /profile/start`` / ``POST /profile/stop``:
   JAX profiler traces (xprof format, viewable in TensorBoard/xprof) are
   written under this directory.  Unset = endpoints disabled (404).
+* ``ARCHIVE_PATH`` — JSON snapshot for the completions archive
+  (checkpoint/resume): loaded at startup when the file exists, saved on
+  graceful shutdown.  Unset = in-memory only.
+* ``ARCHIVE_WRITE`` — archive every UNARY completion the gateway serves
+  (with per-judge ballots, enabling logprob re-extraction in batch
+  re-score), making its id referenceable in later requests.  Defaults on
+  when ``ARCHIVE_PATH`` is set; ``ARCHIVE_WRITE=0`` disables.
 """
 
 from __future__ import annotations
@@ -78,6 +85,8 @@ class Config:
     mesh_dp: Optional[int] = None
     mesh_tp: int = 1
     profile_dir: Optional[str] = None
+    archive_path: Optional[str] = None
+    archive_write: bool = False
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -127,6 +136,13 @@ class Config:
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
             mesh_tp=int(env.get("MESH_TP", 1)),
             profile_dir=env.get("PROFILE_DIR"),
+            archive_path=env.get("ARCHIVE_PATH"),
+            archive_write=(
+                str(
+                    env.get("ARCHIVE_WRITE", "1" if env.get("ARCHIVE_PATH") else "0")
+                ).lower()
+                in ("1", "true", "yes", "on")
+            ),
         )
 
     def backoff_policy(self):

@@ -695,3 +695,111 @@ def test_profile_endpoints_absent_without_config():
         assert (await client.post("/profile/start")).status == 404
 
     go(with_client(app, run))
+
+
+def test_archive_path_snapshot_on_shutdown(tmp_path):
+    """ARCHIVE_PATH: the service loads an existing snapshot at startup and
+    writes one back on graceful shutdown (checkpoint/resume)."""
+    from llm_weighted_consensus_tpu import archive
+    from llm_weighted_consensus_tpu.serve.__main__ import build_service
+    from llm_weighted_consensus_tpu.types.chat_response import (
+        ChatCompletion as ChatUnary,
+    )
+
+    path = str(tmp_path / "archive.json")
+    seed = archive.InMemoryArchive()
+    seed.put_chat(
+        ChatUnary.from_json_obj(
+            {
+                "id": "cc-seeded",
+                "object": "chat.completion",
+                "created": 1,
+                "model": "m",
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": "hi"},
+                        "finish_reason": "stop",
+                    }
+                ],
+            }
+        )
+    )
+    seed.save(path)
+
+    config = Config.from_env(
+        {"ARCHIVE_PATH": path, "OPENAI_API_BASE": "https://up.example",
+         "OPENAI_API_KEY": "k"}
+    )
+    assert config.archive_path == path
+    app = build_service(config)
+
+    # startup load: the seeded completion is in the service's live store
+    from llm_weighted_consensus_tpu.serve.__main__ import ARCHIVE_KEY
+
+    store = app[ARCHIVE_KEY]
+    assert store.chat_ids() == ["cc-seeded"]
+    # ...and fetchable exactly as rehydration would fetch it
+    fetched = go(store.fetch_chat_completion(None, "cc-seeded"))
+    assert fetched.choices[0].message.content == "hi"
+
+    async def run(client):
+        assert (await client.get("/healthz")).status == 200
+
+    go(with_client(app, run))  # with_client closes -> on_cleanup save
+    reloaded = archive.InMemoryArchive.load(path)
+    assert reloaded.chat_ids() == ["cc-seeded"]
+
+
+def test_archive_write_stores_served_unary_completions():
+    """ARCHIVE_WRITE: a served score completion is archived with its
+    ballots, so its id is referenceable and revote-able afterwards."""
+    from llm_weighted_consensus_tpu.archive.rescore import rescore_archive
+    from llm_weighted_consensus_tpu.serve.__main__ import _ArchivingClient
+
+    keys = ballot_keys(2)
+    transport = FakeTransport(
+        [Script([chunk_obj(f"pick {keys[0]}", finish="stop")])]
+    )
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    reg = registry.InMemoryModelRegistry()
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat, reg, archive_fetcher=store,
+        rng_factory=lambda: random.Random(SEED),
+        ballot_sink=store.put_ballot,
+    )
+    app = build_app(chat, _ArchivingClient(score, store.put_score), None)
+
+    async def run(client):
+        resp = await post_json(
+            client,
+            "/score/completions",
+            {
+                "messages": [{"role": "user", "content": "q"}],
+                "model": inline_model([{"model": "j1"}]),
+                "choices": ["first", "second"],
+            },
+        )
+        assert resp.status == 200
+        return (await resp.json())["id"]
+
+    cid = go(with_client(app, run))
+    assert store.score_ids() == [cid]
+    assert store.score_ballots(cid) is not None
+    results = rescore_archive(store, revote=True)
+    conf = [float(x) for x in results[cid]["confidence"]]
+    assert conf[0] == pytest.approx(1.0)
+
+
+def test_archive_write_config_defaults():
+    on = Config.from_env({"ARCHIVE_PATH": "/tmp/x.json"})
+    assert on.archive_write is True
+    off = Config.from_env({"ARCHIVE_PATH": "/tmp/x.json", "ARCHIVE_WRITE": "0"})
+    assert off.archive_write is False
+    bare = Config.from_env({})
+    assert bare.archive_write is False
+    explicit = Config.from_env({"ARCHIVE_WRITE": "1"})
+    assert explicit.archive_write is True

@@ -72,6 +72,9 @@ class InMemoryArchive(Fetcher):
         # the archivable ballot form enabling logprob re-extraction
         # (archive/rescore.py revote; populated via ScoreClient.ballot_sink)
         self._ballots: dict = {}
+        # score completion id -> originating request params (the training
+        # signal source: prompts are embedded for table rows)
+        self._score_requests: dict = {}
 
     def put_chat(self, completion) -> str:
         self._chat[completion.id] = completion
@@ -80,6 +83,19 @@ class InMemoryArchive(Fetcher):
     def put_score(self, completion) -> str:
         self._score[completion.id] = completion
         return completion.id
+
+    def put_score_request(self, completion_id: str, params) -> None:
+        """Keep the originating request beside its completion — training
+        tables learn from the PROMPT embedding (weights/learning.py), and
+        the prompt lives in the request, not the completion."""
+        self._score_requests[completion_id] = params
+
+    def score_request(self, completion_id: str):
+        return self._score_requests.get(completion_id)
+
+    def score_completion(self, completion_id: str):
+        """Sync accessor (the async fetch_* trio serves the client seam)."""
+        return self._score.get(completion_id)
 
     # ballots are recorded for EVERY score request (the sink fires inside
     # create_streaming) but only archived completions keep needing theirs;
@@ -97,13 +113,22 @@ class InMemoryArchive(Fetcher):
             key_indices
         )
         while len(self._ballots) > self.MAX_BALLOT_COMPLETIONS:
-            # evict oldest-first but never an archived completion's ballots
-            # (those are exactly the ones revote still needs)
+            # the cap bounds ORPHANS (streaming requests whose completions
+            # never get archived), oldest first.  Archived completions'
+            # ballots — and the in-flight request being recorded right now
+            # — are never evicted: revote needs the former, put_score
+            # hasn't had its chance at the latter.  When only those
+            # remain, growth is legitimate (it tracks the archive's size).
             victim = next(
-                (c for c in self._ballots if c not in self._score), None
+                (
+                    c
+                    for c in self._ballots
+                    if c not in self._score and c != completion_id
+                ),
+                None,
             )
             if victim is None:
-                victim = next(iter(self._ballots))
+                break
             self._ballots.pop(victim)
 
     def score_ballots(self, completion_id: str) -> Optional[dict]:
@@ -146,8 +171,6 @@ class InMemoryArchive(Fetcher):
     def save(self, path: str) -> None:
         """Snapshot every table (+ ballot records) to one JSON file.
         Written atomically (temp + rename); Decimal-exact via jsonutil."""
-        import os
-
         from ..utils import jsonutil
 
         obj = {
@@ -164,11 +187,15 @@ class InMemoryArchive(Fetcher):
                 for cid, b in self._ballots.items()
                 if cid in self._score
             },
+            "score_requests": {
+                cid: params.to_json_obj()
+                for cid, params in self._score_requests.items()
+                if cid in self._score
+            },
         }
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(jsonutil.dumps(obj))
-        os.replace(tmp, path)
+        from ..utils.io import atomic_write
+
+        atomic_write(path, lambda f: f.write(jsonutil.dumps(obj).encode("utf-8")))
 
     @classmethod
     def load(cls, path: str) -> "InMemoryArchive":
@@ -199,6 +226,12 @@ class InMemoryArchive(Fetcher):
         store._ballots = {
             cid: {int(judge): pairs for judge, pairs in judges.items()}
             for cid, judges in obj.get("ballots", {}).items()
+        }
+        from ..types import score_request
+
+        store._score_requests = {
+            cid: score_request.ChatCompletionCreateParams.from_json_obj(v)
+            for cid, v in obj.get("score_requests", {}).items()
         }
         return store
 

@@ -1,0 +1,103 @@
+"""Offline encoder weight loading (no network — local files only).
+
+One entry point, three accepted layouts:
+
+* an HF snapshot directory (``model.safetensors`` or ``pytorch_model.bin``
+  + usually ``vocab.txt``) — the layout ``huggingface_hub`` snapshots use
+  and the one tests/test_hf_parity.py documents for golden checks;
+* a single weights file (``.safetensors`` / ``.bin`` / ``.pt``);
+* an orbax checkpoint directory written by ``train.save_checkpoint``.
+
+HF state dicts may carry a ``bert.`` prefix (BertForSequenceClassification
+etc.); it is stripped so plain ``BertModel`` and task-head checkpoints both
+load.  Reference note: the reference delegates inference upstream
+(src/chat/completions/client.rs:308-332) and ships no weight loading at
+all — local weights are this framework's whole point.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from .configs import BertConfig
+
+_HF_FILES = ("model.safetensors", "pytorch_model.bin")
+
+
+def _strip_prefix(state: dict) -> dict:
+    if any(key.startswith("bert.") for key in state):
+        return {
+            (key[len("bert."):] if key.startswith("bert.") else key): value
+            for key, value in state.items()
+        }
+    return state
+
+
+def _load_state_dict(path: str) -> dict:
+    """weights file -> {name: np.ndarray}."""
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return dict(load_file(path))
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: v.numpy() for k, v in state.items()}
+
+
+def _is_orbax_dir(path: str) -> bool:
+    if not os.path.isdir(path):
+        return False
+    entries = set(os.listdir(path))
+    return bool(
+        entries
+        & {"_METADATA", "manifest.ocdbt", "_CHECKPOINT_METADATA", "d"}
+    )
+
+
+def load_params(path: str, config: BertConfig, dtype=None) -> dict:
+    """Encoder params pytree from a local checkpoint (see module doc)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import bert
+
+    if dtype is None:
+        dtype = (
+            jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+        )
+    if os.path.isdir(path):
+        for name in _HF_FILES:
+            candidate = os.path.join(path, name)
+            if os.path.exists(candidate):
+                state = _strip_prefix(_load_state_dict(candidate))
+                return bert.from_hf_weights(state, config, dtype=dtype)
+        if _is_orbax_dir(path):
+            from .. import train
+
+            like = bert.init_params(
+                jax.random.PRNGKey(0), config, dtype=dtype
+            )
+            return train.load_checkpoint(path, like=like)
+        raise FileNotFoundError(
+            f"{path!r} is a directory but contains neither an HF weights "
+            f"file ({'/'.join(_HF_FILES)}) nor an orbax checkpoint"
+        )
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    state = _strip_prefix(_load_state_dict(path))
+    return bert.from_hf_weights(state, config, dtype=dtype)
+
+
+def find_vocab(weights_path: str) -> Optional[str]:
+    """vocab.txt sitting next to the weights, if any (HF snapshot layout)."""
+    root = (
+        weights_path
+        if os.path.isdir(weights_path)
+        else os.path.dirname(weights_path)
+    )
+    candidate = os.path.join(root, "vocab.txt")
+    return candidate if os.path.exists(candidate) else None

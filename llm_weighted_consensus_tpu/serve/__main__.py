@@ -27,6 +27,56 @@ FAKE_PORT = 5990
 
 # the service's archive store, exposed for introspection/tests
 ARCHIVE_KEY: web.AppKey = web.AppKey("archive", object)
+# the live judge training tables (when an embedder is configured)
+TABLES_KEY: web.AppKey = web.AppKey("tables", object)
+
+
+def _learn_handler(store, embedder, tables):
+    """POST /weights/learn: build training-table rows from the archive.
+
+    Body: {"model": <inline panel JSON>, "labels": {completion_id: correct
+    candidate index}?, "ids": [completion ids]?}.  Runs on an executor (it
+    embeds prompts on device) and returns {"rows_added": N}.  Idempotent —
+    already-ingested completions are skipped.
+    """
+    import asyncio
+
+    from ..identity.model import ModelBase
+    from ..types.base import SchemaError
+    from ..utils import jsonutil
+    from ..weights.learning import populate_from_archive
+
+    # serialize learn passes: two overlapping POSTs would both pass the
+    # is_ingested check before either marks, duplicating rows
+    lock = asyncio.Lock()
+
+    async def handler(request: web.Request):
+        try:
+            body = jsonutil.loads(await request.text())
+            model = ModelBase.from_json_obj(
+                body["model"]
+            ).into_model_validate()
+            labels = {
+                str(cid): int(idx)
+                for cid, idx in (body.get("labels") or {}).items()
+            }
+            ids = body.get("ids")
+        except (KeyError, TypeError, ValueError, SchemaError) as e:
+            return web.Response(
+                status=400,
+                text=jsonutil.dumps({"code": 400, "message": str(e)}),
+                content_type="application/json",
+            )
+        async with lock:
+            added = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: populate_from_archive(
+                    store, embedder, model, tables, ids=ids, labels=labels
+                ),
+            )
+        return web.json_response({"rows_added": added})
+
+    return handler
 
 
 async def _fake_upstream(request: web.Request) -> web.StreamResponse:
@@ -87,18 +137,26 @@ def build_embedder(config: Config):
     encoder params Megatron-split over tp — parallel/sharding.py)."""
     if not config.embedder_model:
         return None
+    from ..models.configs import PRESETS
     from ..models.embedder import TpuEmbedder
     from ..models.tokenizer import load_tokenizer
 
+    params = None
+    vocab_path = config.embedder_vocab
+    if config.embedder_weights:
+        from ..models.loading import find_vocab, load_params
+
+        params = load_params(
+            config.embedder_weights, PRESETS[config.embedder_model]
+        )
+        if not vocab_path:
+            vocab_path = find_vocab(config.embedder_weights)
     embedder = TpuEmbedder(
         config.embedder_model,
-        # only override the tokenizer when a real vocab is configured;
+        params=params,
+        # only override the tokenizer when a real vocab is available;
         # TpuEmbedder's default hash fallback sizes to the model vocab
-        tokenizer=(
-            load_tokenizer(config.embedder_vocab)
-            if config.embedder_vocab
-            else None
-        ),
+        tokenizer=load_tokenizer(vocab_path) if vocab_path else None,
         max_tokens=config.embedder_max_tokens,
     )
     if config.mesh_dp is not None or config.mesh_tp > 1:
@@ -123,8 +181,11 @@ def build_embedder(config: Config):
 class _ArchivingClient:
     """Wraps a client so every served UNARY completion is archived (its id
     becomes referenceable by later requests); everything else delegates.
-    Streaming responses are consumed by the HTTP caller chunk-by-chunk and
-    are not teed into the archive — unary-only, by design."""
+    ``put(result, params)`` receives the request too — the score path
+    archives it beside the completion, feeding training-table learning
+    (weights/learning.py).  Streaming responses are consumed by the HTTP
+    caller chunk-by-chunk and are not teed into the archive — unary-only,
+    by design."""
 
     def __init__(self, inner, put):
         self._inner = inner
@@ -135,7 +196,7 @@ class _ArchivingClient:
 
     async def create_unary(self, ctx, params):
         result = await self._inner.create_unary(ctx, params)
-        self._put(result)
+        self._put(result, params)
         return result
 
 
@@ -151,8 +212,14 @@ def build_service(config: Config, fake_upstream: bool = False):
         store = archive.InMemoryArchive()
     if config.archive_path:
         # fail FAST on an unwritable path: the shutdown save is the last
-        # moment we could find out, and by then the archive would be lost
-        store.save(config.archive_path)
+        # moment we could find out, and by then the archive would be lost.
+        # A tiny probe, not a full save — re-serializing a just-loaded
+        # multi-GB snapshot would double startup IO for nothing.
+        from ..utils.io import probe_writable
+
+        probe_writable(config.archive_path)
+        if not os.path.exists(config.archive_path):
+            store.save(config.archive_path)
     transport = AiohttpTransport()
     chat_client = DefaultChatClient(
         transport,
@@ -168,11 +235,23 @@ def build_service(config: Config, fake_upstream: bool = False):
     model_registry = registry.InMemoryModelRegistry()
     embedder = build_embedder(config)
     weight_fetchers = WeightFetchers()
+    tables = None
     if embedder is not None:
-        from ..weights.training_table import TpuTrainingTableFetcher
+        from ..weights.training_table import (
+            TpuTrainingTableFetcher,
+            TrainingTableStore,
+        )
 
+        if config.tables_path and os.path.exists(config.tables_path):
+            tables = TrainingTableStore.load(config.tables_path)
+        else:
+            tables = TrainingTableStore()
+        if config.tables_path:
+            from ..utils.io import probe_writable
+
+            probe_writable(config.tables_path)
         weight_fetchers = WeightFetchers(
-            training_table_fetcher=TpuTrainingTableFetcher(embedder)
+            training_table_fetcher=TpuTrainingTableFetcher(embedder, tables)
         )
     score_client = ScoreClient(
         chat_client,
@@ -188,9 +267,18 @@ def build_service(config: Config, fake_upstream: bool = False):
     )
     gw_chat, gw_score, gw_multichat = chat_client, score_client, multichat_client
     if config.archive_write:
-        gw_chat = _ArchivingClient(chat_client, store.put_chat)
-        gw_score = _ArchivingClient(score_client, store.put_score)
-        gw_multichat = _ArchivingClient(multichat_client, store.put_multichat)
+
+        def put_score(result, params):
+            store.put_score(result)
+            store.put_score_request(result.id, params)
+
+        gw_chat = _ArchivingClient(
+            chat_client, lambda result, params: store.put_chat(result)
+        )
+        gw_score = _ArchivingClient(score_client, put_score)
+        gw_multichat = _ArchivingClient(
+            multichat_client, lambda result, params: store.put_multichat(result)
+        )
     app = build_app(
         gw_chat,
         gw_score,
@@ -199,6 +287,11 @@ def build_service(config: Config, fake_upstream: bool = False):
         profile_dir=config.profile_dir,
     )
     app[ARCHIVE_KEY] = store
+    if tables is not None:
+        app[TABLES_KEY] = tables
+        app.router.add_post(
+            "/weights/learn", _learn_handler(store, embedder, tables)
+        )
     if config.archive_path:
         path = config.archive_path
 
@@ -206,6 +299,13 @@ def build_service(config: Config, fake_upstream: bool = False):
             store.save(path)
 
         app.on_cleanup.append(_save_archive)
+    if tables is not None and config.tables_path:
+        tables_path = config.tables_path
+
+        async def _save_tables(app):
+            tables.save(tables_path)
+
+        app.on_cleanup.append(_save_tables)
 
     async def _close_transport(app):
         await transport.close()
@@ -227,13 +327,32 @@ async def _serve(config: Config, fake_upstream: bool) -> None:
     await runner.setup()
     await web.TCPSite(runner, config.address, config.port).start()
     print(f"listening on {config.address}:{config.port}", flush=True)
+
+    # SIGINT/SIGTERM set a stop event instead of raising KeyboardInterrupt
+    # mid-coroutine: cleanup (archive/tables snapshots, session close) then
+    # runs to completion with no interrupt in flight — asyncio's default
+    # handling can fire KeyboardInterrupt INSIDE a cleanup hook and lose
+    # whichever snapshot hadn't been written yet
+    import signal
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    handled = []
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+            handled.append(sig)
+        except (NotImplementedError, RuntimeError):
+            pass
     try:
-        await asyncio.Event().wait()
+        await stop.wait()
     finally:
-        # run the app's on_cleanup hooks (e.g. the ARCHIVE_PATH snapshot)
-        # on SIGINT/cancellation — without this, graceful shutdown never
-        # fires them in the real service path
+        # handlers stay installed THROUGH cleanup: a repeated signal
+        # (operator mashing ctrl-C, a supervisor forwarding the signal)
+        # must not interrupt a snapshot mid-write
         await runner.cleanup()
+        for sig in handled:
+            loop.remove_signal_handler(sig)
 
 
 def main() -> None:

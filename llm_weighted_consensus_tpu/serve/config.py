@@ -8,8 +8,12 @@ TPU additions:
 
 * ``EMBEDDER_MODEL``  — encoder preset (``bge-small-en`` / ``bge-base-en`` /
   ``bge-large-en``); unset = no device side (static weights only).
-* ``EMBEDDER_VOCAB``  — path to a WordPiece ``vocab.txt``; unset = hash
-  tokenizer fallback.
+* ``EMBEDDER_WEIGHTS`` — local checkpoint for the encoder: an HF snapshot
+  dir (model.safetensors / pytorch_model.bin), a single weights file, or
+  an orbax dir (models/loading.py).  Unset = random init (demo mode).
+* ``EMBEDDER_VOCAB``  — path to a WordPiece ``vocab.txt``; defaults to
+  the vocab.txt beside EMBEDDER_WEIGHTS when present, else hash-tokenizer
+  fallback.
 * ``EMBEDDER_MAX_TOKENS`` — truncation window (default 512).
 * ``MESH_DP`` / ``MESH_TP`` — serve the embedder over a (dp, tp) device
   mesh: batches shard over ``dp``, encoder params Megatron-split over
@@ -24,9 +28,14 @@ TPU additions:
   (checkpoint/resume): loaded at startup when the file exists, saved on
   graceful shutdown.  Unset = in-memory only.
 * ``ARCHIVE_WRITE`` — archive every UNARY completion the gateway serves
-  (with per-judge ballots, enabling logprob re-extraction in batch
-  re-score), making its id referenceable in later requests.  Defaults on
-  when ``ARCHIVE_PATH`` is set; ``ARCHIVE_WRITE=0`` disables.
+  (with per-judge ballots and the originating score request, enabling
+  logprob re-extraction and training-table learning), making its id
+  referenceable in later requests.  Defaults on when ``ARCHIVE_PATH`` is
+  set; ``ARCHIVE_WRITE=0`` disables.
+* ``TABLES_PATH`` — .npz snapshot for the judge training tables: loaded
+  at startup when present, saved on graceful shutdown.  With an embedder
+  configured, ``POST /weights/learn`` builds rows from the archive into
+  the live tables (weights/learning.py).
 """
 
 from __future__ import annotations
@@ -80,6 +89,7 @@ class Config:
     port: int = 5000
     # TPU-framework additions
     embedder_model: Optional[str] = None  # e.g. "bge-small-en"
+    embedder_weights: Optional[str] = None  # local checkpoint path
     embedder_vocab: Optional[str] = None  # path to vocab.txt
     embedder_max_tokens: int = 512
     mesh_dp: Optional[int] = None
@@ -87,6 +97,7 @@ class Config:
     profile_dir: Optional[str] = None
     archive_path: Optional[str] = None
     archive_write: bool = False
+    tables_path: Optional[str] = None
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -131,6 +142,7 @@ class Config:
             address=env.get("ADDRESS", "0.0.0.0"),
             port=int(env.get("PORT", 5000)),
             embedder_model=env.get("EMBEDDER_MODEL"),
+            embedder_weights=env.get("EMBEDDER_WEIGHTS"),
             embedder_vocab=env.get("EMBEDDER_VOCAB"),
             embedder_max_tokens=int(env.get("EMBEDDER_MAX_TOKENS", 512)),
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
@@ -143,6 +155,7 @@ class Config:
                 ).lower()
                 in ("1", "true", "yes", "on")
             ),
+            tables_path=env.get("TABLES_PATH"),
         )
 
     def backoff_policy(self):

@@ -1,0 +1,31 @@
+"""Small filesystem helpers shared by the snapshot writers."""
+
+from __future__ import annotations
+
+import os
+
+
+def atomic_write(path: str, write_fn) -> None:
+    """Write via a temp file + ``os.replace`` so readers never observe a
+    truncated file and concurrent writers can't corrupt each other.
+    ``write_fn(f)`` receives the open binary file object."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def probe_writable(path: str) -> None:
+    """Fail fast (OSError) if ``path`` cannot be written, without writing
+    anything expensive: create + remove a tiny sibling temp file."""
+    probe = f"{path}.probe.{os.getpid()}"
+    with open(probe, "wb") as f:
+        f.write(b"")
+    os.unlink(probe)

@@ -37,6 +37,15 @@ class TrainingTableStore:
 
     def __init__(self) -> None:
         self._tables: dict = {}
+        # completion ids already learned from (weights/learning.py) — the
+        # idempotence guard for repeated sync passes over one archive
+        self._ingested: set = set()
+
+    def is_ingested(self, completion_id: str) -> bool:
+        return completion_id in self._ingested
+
+    def mark_ingested(self, completion_id: str) -> None:
+        self._ingested.add(completion_id)
 
     def add_rows(
         self, table_id: str, embeddings: np.ndarray, scores: np.ndarray
@@ -55,11 +64,41 @@ class TrainingTableStore:
     def __len__(self) -> int:
         return len(self._tables)
 
+    # -- disk snapshot (pairs with archive snapshots for full resume) -------
+
+    def save(self, path: str) -> None:
+        """One .npz holding every table + the ingested-id set (atomic)."""
+        from ..utils.io import atomic_write
+
+        arrays = {}
+        for table_id, (emb, scores) in self._tables.items():
+            arrays[f"e:{table_id}"] = emb
+            arrays[f"s:{table_id}"] = scores
+        arrays["ingested"] = np.asarray(sorted(self._ingested), dtype="U")
+        atomic_write(path, lambda f: np.savez(f, **arrays))
+
+    @classmethod
+    def load(cls, path: str) -> "TrainingTableStore":
+        store = cls()
+        with np.load(path) as data:
+            for key in data.files:
+                if key.startswith("e:"):
+                    table_id = key[2:]
+                    store._tables[table_id] = (
+                        data[key], data[f"s:{table_id}"]
+                    )
+            if "ingested" in data.files:
+                store._ingested = set(data["ingested"].tolist())
+        return store
+
 
 class TpuTrainingTableFetcher(TrainingTableWeightFetcher):
     def __init__(self, embedder, store: Optional[TrainingTableStore] = None):
         self.embedder = embedder
-        self.store = store or TrainingTableStore()
+        # NOT `store or ...`: an EMPTY shared store is falsy (__len__ == 0)
+        # and would be silently replaced, detaching the fetcher from the
+        # store that learning later populates
+        self.store = store if store is not None else TrainingTableStore()
 
     async def fetch(self, ctx, request, model):
         import asyncio

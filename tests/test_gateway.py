@@ -771,7 +771,11 @@ def test_archive_write_stores_served_unary_completions():
         rng_factory=lambda: random.Random(SEED),
         ballot_sink=store.put_ballot,
     )
-    app = build_app(chat, _ArchivingClient(score, store.put_score), None)
+    def put_score(result, params):
+        store.put_score(result)
+        store.put_score_request(result.id, params)
+
+    app = build_app(chat, _ArchivingClient(score, put_score), None)
 
     async def run(client):
         resp = await post_json(

@@ -252,3 +252,123 @@ def test_real_bge_checkpoint_golden():
         bert.embed(params, jnp.asarray(ids), jnp.asarray(mask), config)
     )
     np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+
+# -- offline weight loading (models/loading.py) -------------------------------
+
+
+def _assert_same_params(a, b):
+    import jax
+
+    flat_a = jax.tree_util.tree_leaves(a)
+    flat_b = jax.tree_util.tree_leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+
+def test_load_params_torch_bin(tmp_path, hf_model):
+    from llm_weighted_consensus_tpu.models import bert
+    from llm_weighted_consensus_tpu.models.loading import load_params
+
+    path = str(tmp_path / "pytorch_model.bin")
+    torch.save(hf_model.state_dict(), path)
+    loaded = load_params(path, TINY)
+    direct = bert.from_hf_weights(
+        {k: v.numpy() for k, v in hf_model.state_dict().items()}, TINY
+    )
+    _assert_same_params(loaded, direct)
+
+
+def test_load_params_snapshot_dir_safetensors_with_prefix(
+    tmp_path, hf_model
+):
+    """HF snapshot dir: model.safetensors with a bert. prefix (task-head
+    checkpoints) + vocab.txt found beside the weights."""
+    from safetensors.numpy import save_file
+
+    from llm_weighted_consensus_tpu.models import bert
+    from llm_weighted_consensus_tpu.models.loading import (
+        find_vocab,
+        load_params,
+    )
+
+    state = {
+        f"bert.{k}": v.numpy().copy()
+        for k, v in hf_model.state_dict().items()
+    }
+    save_file(state, str(tmp_path / "model.safetensors"))
+    (tmp_path / "vocab.txt").write_text(
+        "\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "a"]) + "\n"
+    )
+    loaded = load_params(str(tmp_path), TINY)
+    direct = bert.from_hf_weights(
+        {k: v.numpy() for k, v in hf_model.state_dict().items()}, TINY
+    )
+    _assert_same_params(loaded, direct)
+    assert find_vocab(str(tmp_path)) == str(tmp_path / "vocab.txt")
+
+
+def test_load_params_orbax_round_trip(tmp_path):
+    import jax
+
+    from llm_weighted_consensus_tpu import train
+    from llm_weighted_consensus_tpu.models import bert
+    from llm_weighted_consensus_tpu.models.loading import load_params
+
+    params = bert.init_params(jax.random.PRNGKey(1), TINY)
+    path = str(tmp_path / "ckpt")
+    train.save_checkpoint(path, params)
+    loaded = load_params(path, TINY)
+    _assert_same_params(loaded, params)
+
+
+def test_build_embedder_loads_weights(tmp_path):
+    """EMBEDDER_WEIGHTS end-to-end: the service's embedder reproduces the
+    checkpoint's embeddings (not a random init)."""
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.serve import Config
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    hf_config = transformers.BertConfig(
+        vocab_size=TEST_TINY.vocab_size,
+        hidden_size=TEST_TINY.hidden_size,
+        num_hidden_layers=TEST_TINY.num_layers,
+        num_attention_heads=TEST_TINY.num_heads,
+        intermediate_size=TEST_TINY.intermediate_size,
+        max_position_embeddings=TEST_TINY.max_position_embeddings,
+        hidden_act="gelu",
+    )
+    torch.manual_seed(3)
+    model = transformers.BertModel(hf_config, add_pooling_layer=False)
+    model.eval()
+    torch.save(model.state_dict(), str(tmp_path / "pytorch_model.bin"))
+
+    config = Config.from_env(
+        {
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_WEIGHTS": str(tmp_path),
+            "EMBEDDER_MAX_TOKENS": "32",
+        }
+    )
+    embedder = build_embedder(config)
+    ids, mask = embedder.tokenize(["checkpoint weights loaded"])
+    ours = embedder.embed_tokens(np.asarray(ids), np.asarray(mask))
+    with torch.no_grad():
+        hidden = model(
+            input_ids=torch.tensor(np.asarray(ids), dtype=torch.long),
+            attention_mask=torch.tensor(np.asarray(mask), dtype=torch.long),
+        ).last_hidden_state
+        ref = torch.nn.functional.normalize(hidden[:, 0], p=2, dim=-1)
+    np.testing.assert_allclose(ours, ref.numpy(), atol=2e-4, rtol=1e-3)
+
+
+def test_load_params_clear_errors(tmp_path):
+    from llm_weighted_consensus_tpu.models.loading import load_params
+
+    with pytest.raises(FileNotFoundError):
+        load_params(str(tmp_path / "nope.bin"), TINY)
+    empty = tmp_path / "emptydir"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError, match="neither"):
+        load_params(str(empty), TINY)

@@ -410,3 +410,388 @@ def test_ballot_eviction_prefers_unarchived():
         store.put_ballot(f"scrcpl-{i}", 0, [("`A`", 0)])
     assert store.score_ballots("scrcpl-keep") is not None
     assert len(store._ballots) == cap
+
+
+# -- training-table learning from archived outcomes ---------------------------
+
+
+def _panel_and_archive(embedder, votes_by_judge, prompt="what is 2+2?"):
+    """Run one score request through the real client with J judges voting
+    per ``votes_by_judge`` (list of candidate indices), archive completion
+    + request, return (store, model, result)."""
+    import random
+
+    from llm_weighted_consensus_tpu import archive, registry
+    from llm_weighted_consensus_tpu.ballot import PrefixTree
+    from llm_weighted_consensus_tpu.clients.chat import (
+        ApiBase,
+        BackoffPolicy,
+        DefaultChatClient,
+    )
+    from llm_weighted_consensus_tpu.clients.score import ScoreClient
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from fakes import FakeTransport, Script, chunk_obj
+
+    seed = 17
+    rng = random.Random(seed)
+    tree = PrefixTree.build(rng, 2, 20)
+    keys = {idx: k for k, idx in tree.key_indices(rng)}
+
+    model = ModelBase.from_json_obj(
+        {
+            "llms": [
+                {
+                    "model": f"learn-judge-{j}",
+                    "weight": {
+                        "type": "training_table",
+                        "base_weight": 1,
+                        "min_weight": 1,
+                        "max_weight": 5,
+                    },
+                }
+                for j in range(len(votes_by_judge))
+            ],
+            "weight": {
+                "type": "training_table",
+                "embeddings": {"model": "test-tiny", "max_tokens": 32},
+                "top": 3,
+            },
+        }
+    ).into_model_validate()
+    # scripts are consumed in panel (sorted-by-id) order; map back to the
+    # requested vote per judge name
+    vote_by_name = {
+        f"learn-judge-{j}": v for j, v in enumerate(votes_by_judge)
+    }
+    scripts = [
+        Script(
+            [
+                chunk_obj(
+                    f"pick {keys[vote_by_name[llm.base.model]]}",
+                    model=llm.base.model,
+                    finish="stop",
+                )
+            ]
+        )
+        for llm in model.llms
+    ]
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")],
+        backoff=BackoffPolicy(max_elapsed_ms=0),
+    )
+    from llm_weighted_consensus_tpu.weights import WeightFetchers
+
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat, registry.InMemoryModelRegistry(), archive_fetcher=store,
+        rng_factory=lambda: random.Random(seed),
+        weight_fetchers=WeightFetchers(
+            training_table_fetcher=TpuTrainingTableFetcher(embedder)
+        ),
+    )
+    params = ScoreParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": prompt}],
+            "model": {
+                "llms": [llm.base.to_json_obj() for llm in model.llms],
+                "weight": {
+                    "type": "training_table",
+                    "embeddings": {"model": "test-tiny", "max_tokens": 32},
+                    "top": 3,
+                },
+            },
+            "choices": ["four", "five"],
+        }
+    )
+    result = go(score.create_unary(None, params))
+    store.put_score(result)
+    store.put_score_request(result.id, params)
+    return store, model, result
+
+
+def test_judge_alignment_scores_self_consistency_and_supervised(embedder):
+    from llm_weighted_consensus_tpu.weights.learning import (
+        judge_alignment_scores,
+    )
+
+    # 3 judges: two vote candidate 0, one votes candidate 1 -> conf 2/3, 1/3
+    store, model, result = _panel_and_archive(embedder, [0, 0, 1])
+    scores = judge_alignment_scores(result)
+    by_name = {}
+    for choice in result.choices:
+        if choice.model_index is not None:
+            llm = next(
+                l for l in model.llms if l.id == choice.model
+            )
+            by_name[llm.base.model] = scores[choice.model_index]
+    assert by_name["learn-judge-0"] == pytest.approx(2 / 3)
+    assert by_name["learn-judge-1"] == pytest.approx(2 / 3)
+    assert by_name["learn-judge-2"] == pytest.approx(1 / 3)
+
+    # supervised: candidate 1 was actually correct
+    supervised = judge_alignment_scores(result, label=1)
+    by_name_sup = {}
+    for choice in result.choices:
+        if choice.model_index is not None:
+            llm = next(l for l in model.llms if l.id == choice.model)
+            by_name_sup[llm.base.model] = supervised[choice.model_index]
+    assert by_name_sup["learn-judge-0"] == 0.0
+    assert by_name_sup["learn-judge-2"] == 1.0
+
+
+def test_populate_from_archive_closes_the_loop(embedder):
+    """serve -> archive -> learn -> the next lookup weights majority judges
+    above the dissenter."""
+    import asyncio
+    from decimal import Decimal
+
+    from llm_weighted_consensus_tpu.weights.learning import (
+        populate_from_archive,
+    )
+    from llm_weighted_consensus_tpu.weights.training_table import (
+        TpuTrainingTableFetcher,
+        TrainingTableStore,
+    )
+
+    prompt = "what is 2+2?"
+    store, model, result = _panel_and_archive(
+        embedder, [0, 0, 1], prompt=prompt
+    )
+    tables = TrainingTableStore()
+    added = populate_from_archive(store, embedder, model, tables)
+    assert added == 3  # one row per judge
+    assert len(tables) == 3  # distinct training_table_ids... or fewer
+
+    fetcher = TpuTrainingTableFetcher(embedder, tables)
+    request = store.score_request(result.id)
+    weights, _ = asyncio.new_event_loop().run_until_complete(
+        fetcher.fetch(None, request, model)
+    )
+    by_name = {
+        llm.base.model: float(weights[llm.index]) for llm in model.llms
+    }
+    assert by_name["learn-judge-0"] > by_name["learn-judge-2"]
+    assert by_name["learn-judge-0"] == pytest.approx(
+        1 + (5 - 1) * 2 / 3, abs=0.2
+    )
+    assert all(Decimal(1) <= w <= Decimal(5) for w in weights)
+
+
+def test_training_table_store_snapshot_round_trip(tmp_path):
+    from llm_weighted_consensus_tpu.weights.training_table import (
+        TrainingTableStore,
+    )
+
+    store = TrainingTableStore()
+    rng = np.random.default_rng(0)
+    store.add_rows("t1", rng.random((3, 8)), np.asarray([0.1, 0.5, 0.9]))
+    store.add_rows("t2", rng.random((2, 8)), np.asarray([1.0, 0.0]))
+    path = str(tmp_path / "tables.npz")
+    store.save(path)
+    loaded = TrainingTableStore.load(path)
+    assert len(loaded) == 2
+    for tid in ("t1", "t2"):
+        e0, s0 = store.get(tid)
+        e1, s1 = loaded.get(tid)
+        np.testing.assert_array_equal(e0, e1)
+        np.testing.assert_array_equal(s0, s1)
+
+
+def test_populate_is_idempotent(embedder):
+    from llm_weighted_consensus_tpu.weights.learning import (
+        populate_from_archive,
+    )
+    from llm_weighted_consensus_tpu.weights.training_table import (
+        TrainingTableStore,
+    )
+
+    store, model, result = _panel_and_archive(embedder, [0, 1])
+    tables = TrainingTableStore()
+    assert populate_from_archive(store, embedder, model, tables) == 2
+    # a second sync pass over the same archive adds nothing
+    assert populate_from_archive(store, embedder, model, tables) == 0
+    emb, scores = tables.get(model.llms[0].training_table_id)
+    assert emb.shape[0] == 1
+
+
+def test_weights_learn_endpoint_and_tables_snapshot(embedder, tmp_path):
+    """POST /weights/learn over the live service: archive -> rows; the
+    tables snapshot persists on shutdown and reloads."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_weighted_consensus_tpu.serve import Config
+    from llm_weighted_consensus_tpu.serve.__main__ import (
+        ARCHIVE_KEY,
+        TABLES_KEY,
+        build_service,
+    )
+    from llm_weighted_consensus_tpu.utils import jsonutil
+    from llm_weighted_consensus_tpu.weights.training_table import (
+        TrainingTableStore,
+    )
+
+    seed_store, model, result = _panel_and_archive(embedder, [0, 0, 1])
+    tables_path = str(tmp_path / "tables.npz")
+    config = Config.from_env(
+        {
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_MAX_TOKENS": "32",
+            "TABLES_PATH": tables_path,
+        }
+    )
+    app = build_service(config, fake_upstream=True)
+    # seed the service's archive with the externally-scored history
+    app[ARCHIVE_KEY]._score.update(seed_store._score)
+    app[ARCHIVE_KEY]._score_requests.update(seed_store._score_requests)
+
+    body = jsonutil.dumps(
+        {"model": {
+            "llms": [llm.base.to_json_obj() for llm in model.llms],
+            "weight": {
+                "type": "training_table",
+                "embeddings": {"model": "test-tiny", "max_tokens": 32},
+                "top": 3,
+            },
+        }}
+    )
+
+    async def run():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/weights/learn",
+                data=body,
+                headers={"content-type": "application/json"},
+            )
+            assert resp.status == 200
+            assert (await resp.json())["rows_added"] == 3
+            # idempotent second pass
+            resp = await client.post(
+                "/weights/learn",
+                data=body,
+                headers={"content-type": "application/json"},
+            )
+            assert (await resp.json())["rows_added"] == 0
+            # malformed body is a clean 400
+            resp = await client.post(
+                "/weights/learn",
+                data=b"{}",
+                headers={"content-type": "application/json"},
+            )
+            assert resp.status == 400
+        finally:
+            await client.close()  # -> on_cleanup -> tables snapshot
+
+    asyncio.new_event_loop().run_until_complete(run())
+    assert len(app[TABLES_KEY]) == 3
+    reloaded = TrainingTableStore.load(tables_path)
+    assert len(reloaded) == 3
+    # ingestion keys are table-scoped: one per judge table for this cid
+    assert any(
+        key.endswith(f"/{result.id}") for key in reloaded._ingested
+    )
+
+
+def test_fetcher_keeps_shared_empty_store(embedder):
+    """Regression: an EMPTY shared store is falsy (len 0); the fetcher must
+    still use it — learning populates it AFTER the fetcher is built."""
+    from llm_weighted_consensus_tpu.weights.training_table import (
+        TpuTrainingTableFetcher,
+        TrainingTableStore,
+    )
+
+    shared = TrainingTableStore()
+    fetcher = TpuTrainingTableFetcher(embedder, shared)
+    assert fetcher.store is shared
+    shared.add_rows("t", np.ones((1, 4)), np.ones(1))
+    assert fetcher.store.get("t") is not None
+
+
+def test_ballot_cap_never_starves_inflight_or_archived():
+    """Saturation regression: with the cap full of ARCHIVED ballots, a new
+    in-flight request's ballots must survive until its put_score."""
+    from llm_weighted_consensus_tpu import archive
+
+    store = archive.InMemoryArchive()
+    cap = store.MAX_BALLOT_COMPLETIONS
+    for i in range(cap):
+        cid = f"scrcpl-{i}"
+        store.put_ballot(cid, 0, [("`A`", 0)])
+        store._score[cid] = object()  # archived
+    store.put_ballot("scrcpl-inflight", 0, [("`A`", 0)])
+    assert store.score_ballots("scrcpl-inflight") is not None
+    # archived ones all retained too (growth beyond cap is the archive's)
+    assert store.score_ballots("scrcpl-0") is not None
+
+
+def test_second_panel_learns_from_same_archive(embedder):
+    """Cross-panel learning semantics: a RE-WEIGHTED panel shares its
+    judges' weight-invariant table ids (no duplicate rows, lookups just
+    work); a panel with genuinely different judge configs gets its own
+    tables populated from the same archived history."""
+    from llm_weighted_consensus_tpu.weights.learning import (
+        populate_from_archive,
+    )
+    from llm_weighted_consensus_tpu.weights.training_table import (
+        TrainingTableStore,
+    )
+
+    def panel(extra=None):
+        judges = []
+        for j in range(2):
+            judge = {
+                "model": f"learn-judge-{j}",
+                "weight": {
+                    "type": "training_table",
+                    "base_weight": 1,
+                    "min_weight": 1,
+                    "max_weight": 5,
+                },
+            }
+            judge.update(extra or {})
+            judges.append(judge)
+        return ModelBase.from_json_obj(
+            {
+                "llms": judges,
+                "weight": {
+                    "type": "training_table",
+                    "embeddings": {"model": "test-tiny", "max_tokens": 32},
+                    "top": 3,
+                },
+            }
+        ).into_model_validate()
+
+    store, model_a, result = _panel_and_archive(embedder, [0, 1])
+    tables = TrainingTableStore()
+    assert populate_from_archive(store, embedder, model_a, tables) == 2
+
+    # re-weighted panel: same judges, new weight bounds -> SAME table ids
+    # (weight-invariant identity) -> nothing to re-learn, no duplicates
+    reweighted = panel({"weight": {
+        "type": "training_table", "base_weight": 2,
+        "min_weight": 1, "max_weight": 5,
+    }})
+    # same tt ids (panels sort judges by full id, so compare as sets)
+    assert {l.training_table_id for l in reweighted.llms} == {
+        l.training_table_id for l in model_a.llms
+    }
+    assert populate_from_archive(store, embedder, reweighted, tables) == 0
+    emb, _ = tables.get(model_a.llms[0].training_table_id)
+    assert emb.shape[0] == 1  # still one row per judge
+
+    # genuinely different judge config (temperature) -> new table ids ->
+    # the same archived history is learned into the new tables (matched
+    # via the archived request's inline panel, weight-invariant ids)
+    hotter = panel({"temperature": 0.5})
+    assert hotter.llms[0].training_table_id != model_a.llms[0].training_table_id
+    # matching falls back to the ARCHIVED judges' ids, so these rows are
+    # keyed by the archived tables (already ingested) -> 0 new rows; the
+    # hotter panel's own tables stay empty because no archived judge
+    # matches its config
+    assert populate_from_archive(store, embedder, hotter, tables) == 0
+    assert tables.get(hotter.llms[0].training_table_id) is None

@@ -22,13 +22,16 @@ class BertConfig:
     # parallel/ring.py's shard_map over ``ring_axis``).
     attention_impl: str = "auto"
     ring_axis: str = "sp"
+    # family-default pooling: bge uses CLS, e5/gte use masked mean
+    # (both + l2-normalize); TpuEmbedder reads this unless overridden
+    pooling: str = "cls"
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
 
 
-# BGE family (BAAI/bge-*-en-v1.5 shapes)
+# BGE family (BAAI/bge-*-en-v1.5 shapes; CLS pooling)
 BGE_SMALL = BertConfig(
     hidden_size=384, num_layers=12, num_heads=12, intermediate_size=1536
 )
@@ -37,6 +40,35 @@ BGE_BASE = BertConfig(
 )
 BGE_LARGE = BertConfig(
     hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096
+)
+
+# E5 family (intfloat/e5-*-v2: BERT arch, masked-MEAN pooling, "query:"/
+# "passage:" input prefixes are the caller's concern)
+E5_SMALL = BertConfig(
+    hidden_size=384, num_layers=12, num_heads=12, intermediate_size=1536,
+    pooling="mean",
+)
+E5_BASE = BertConfig(
+    hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072,
+    pooling="mean",
+)
+E5_LARGE = BertConfig(
+    hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096,
+    pooling="mean",
+)
+
+# GTE family (thenlper/gte-*: BERT arch, masked-MEAN pooling)
+GTE_SMALL = BertConfig(
+    hidden_size=384, num_layers=12, num_heads=12, intermediate_size=1536,
+    pooling="mean",
+)
+GTE_BASE = BertConfig(
+    hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072,
+    pooling="mean",
+)
+GTE_LARGE = BertConfig(
+    hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096,
+    pooling="mean",
 )
 
 # tiny config for tests: fast init/compile on the CPU mesh
@@ -53,6 +85,12 @@ PRESETS = {
     "bge-small-en": BGE_SMALL,
     "bge-base-en": BGE_BASE,
     "bge-large-en": BGE_LARGE,
+    "e5-small-v2": E5_SMALL,
+    "e5-base-v2": E5_BASE,
+    "e5-large-v2": E5_LARGE,
+    "gte-small": GTE_SMALL,
+    "gte-base": GTE_BASE,
+    "gte-large": GTE_LARGE,
     "test-tiny": TEST_TINY,
 }
 

@@ -95,13 +95,15 @@ class TpuEmbedder:
         tokenizer: Optional[BaseTokenizer] = None,
         dtype=None,
         max_tokens: int = 512,
-        pooling: str = "cls",
+        pooling: Optional[str] = None,
         seed: int = 0,
     ) -> None:
         self.model_name = model
         self.config = config or PRESETS[model]
         self.max_tokens = min(max_tokens, self.config.max_position_embeddings)
-        self.pooling = pooling
+        # family default from the config (bge: CLS, e5/gte: masked mean)
+        # unless the caller overrides
+        self.pooling = pooling if pooling is not None else self.config.pooling
         if dtype is None:
             dtype = (
                 jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32

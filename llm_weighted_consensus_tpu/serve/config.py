@@ -6,8 +6,10 @@ loading mirrors dotenv: simple KEY=VALUE lines, environment wins.
 
 TPU additions:
 
-* ``EMBEDDER_MODEL``  — encoder preset (``bge-small-en`` / ``bge-base-en`` /
-  ``bge-large-en``); unset = no device side (static weights only).
+* ``EMBEDDER_MODEL``  — encoder preset: ``bge-{small,base,large}-en`` (CLS
+  pooling), ``e5-{small,base,large}-v2`` / ``gte-{small,base,large}``
+  (masked-mean pooling — family default applied automatically).  Unset =
+  no device side (static weights only).
 * ``EMBEDDER_WEIGHTS`` — local checkpoint for the encoder: an HF snapshot
   dir (model.safetensors / pytorch_model.bin), a single weights file, or
   an orbax dir (models/loading.py).  Unset = random init (demo mode).

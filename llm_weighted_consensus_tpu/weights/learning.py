@@ -90,7 +90,9 @@ def populate_from_archive(
     """
     from ..identity.model import ModelBase
 
-    ids = list(ids if ids is not None else store.score_ids())
+    # dedup: repeated ids in one call would pass the is_ingested scan
+    # twice (marks only land after embedding) and double-insert rows
+    ids = list(dict.fromkeys(ids if ids is not None else store.score_ids()))
     by_judge_id = {llm.id: llm for llm in model.llms}
     if max_tokens is None:
         # match the LOOKUP's truncation (panel embeddings config): stored
@@ -141,17 +143,22 @@ def populate_from_archive(
 
     # group rows per table so each table concatenates ONCE (appending row
     # by row would copy the whole table per completion — quadratic)
-    added = 0
     by_table: dict = {}
     for cid, pos, rows in per_completion:
         for table_id, score in rows.items():
-            embs, scores = by_table.setdefault(table_id, ([], []))
+            embs, scores, cids = by_table.setdefault(table_id, ([], [], []))
             embs.append(embeddings[pos])
             scores.append(score)
-            table_store.mark_ingested(f"{table_id}/{cid}")
-            added += 1
-    for table_id, (embs, scores) in by_table.items():
+            cids.append(cid)
+    added = 0
+    for table_id, (embs, scores, cids) in by_table.items():
         table_store.add_rows(
             table_id, np.stack(embs), np.asarray(scores, dtype=np.float32)
         )
+        # mark ONLY what actually landed: marking up front would poison
+        # idempotence if an add_rows raises (e.g. embedding-dim mismatch
+        # after an embedder swap) and strand that history as unlearnable
+        for cid in cids:
+            table_store.mark_ingested(f"{table_id}/{cid}")
+        added += len(scores)
     return added

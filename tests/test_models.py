@@ -261,3 +261,40 @@ def test_embed_and_vote_many_matches_single():
     for i, (ids, mask) in enumerate(reqs):
         single = np.asarray(emb.consensus_confidence_tokens(ids, mask))
         np.testing.assert_allclose(batched[i], single, atol=1e-5)
+
+
+def test_model_family_presets_and_pooling():
+    """e5/gte families: same BERT arch, masked-mean pooling by default;
+    bge stays CLS.  All presets are loadable shapes."""
+    from llm_weighted_consensus_tpu.models import configs
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    assert configs.PRESETS["bge-large-en"].pooling == "cls"
+    for name in ("e5-small-v2", "e5-base-v2", "e5-large-v2",
+                 "gte-small", "gte-base", "gte-large"):
+        assert configs.PRESETS[name].pooling == "mean", name
+    # e5 shapes mirror bge shapes (both BERT arch)
+    assert (
+        configs.PRESETS["e5-large-v2"].hidden_size
+        == configs.PRESETS["bge-large-en"].hidden_size
+    )
+    # the embedder picks up the family default and honors overrides
+    emb = TpuEmbedder(
+        "e5-small-v2", config=configs.TEST_TINY, max_tokens=32
+    )
+    assert emb.pooling == "cls"  # TEST_TINY's own default
+    import dataclasses
+
+    mean_tiny = dataclasses.replace(configs.TEST_TINY, pooling="mean")
+    emb = TpuEmbedder("e5-small-v2", config=mean_tiny, max_tokens=32)
+    assert emb.pooling == "mean"
+    emb = TpuEmbedder(
+        "e5-small-v2", config=mean_tiny, max_tokens=32, pooling="cls"
+    )
+    assert emb.pooling == "cls"
+    # mean pooling produces valid normalized embeddings
+    emb = TpuEmbedder("test-tiny", config=mean_tiny, max_tokens=32)
+    out = emb.embed_texts(["hello world", "longer text with more words"])
+    np.testing.assert_allclose(
+        np.linalg.norm(out, axis=1), 1.0, atol=1e-5
+    )

@@ -795,3 +795,33 @@ def test_second_panel_learns_from_same_archive(embedder):
     # matches its config
     assert populate_from_archive(store, embedder, hotter, tables) == 0
     assert tables.get(hotter.llms[0].training_table_id) is None
+
+
+def test_populate_duplicate_ids_and_failure_do_not_poison(embedder):
+    from llm_weighted_consensus_tpu.weights.learning import (
+        populate_from_archive,
+    )
+    from llm_weighted_consensus_tpu.weights.training_table import (
+        TrainingTableStore,
+    )
+
+    store, model, result = _panel_and_archive(embedder, [0, 1])
+    tables = TrainingTableStore()
+    # duplicate ids in one call add rows once
+    added = populate_from_archive(
+        store, embedder, model, tables, ids=[result.id, result.id]
+    )
+    assert added == 2
+    emb, _ = tables.get(model.llms[0].training_table_id)
+    assert emb.shape[0] == 1
+
+    # a failing add_rows must NOT mark anything ingested
+    fresh = TrainingTableStore()
+    # poison the table with wrong-dim rows so concatenate raises
+    for llm in model.llms:
+        fresh.add_rows(llm.training_table_id, np.ones((1, 3)), np.ones(1))
+    with pytest.raises(ValueError):
+        populate_from_archive(store, embedder, model, fresh)
+    assert not any(
+        key.endswith(f"/{result.id}") for key in fresh._ingested
+    )

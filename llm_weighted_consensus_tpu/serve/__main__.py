@@ -31,24 +31,95 @@ ARCHIVE_KEY: web.AppKey = web.AppKey("archive", object)
 TABLES_KEY: web.AppKey = web.AppKey("tables", object)
 
 
-def _learn_handler(store, embedder, tables):
+def _rescore_handler(store, lock, mesh=None):
+    """POST /archive/rescore: re-tally archived score completions on device
+    (BASELINE config 4 as a service operation), dp-sharded when the
+    service has a mesh.
+
+    Body (all optional): {"weight_overrides": {judge id: weight},
+    "ids": [completion ids], "revote": bool (re-extract soft votes from
+    stored logprobs), "apply": bool (write results back into the archive),
+    "include_results": bool}.  Runs on an executor under the shared
+    archive-mutation lock.
+    """
+    from ..archive.rescore import apply_rescore, rescore_archive
+    from ..types.base import SchemaError
+    from ..utils import jsonutil
+
+    def bad_request(message):
+        return web.Response(
+            status=400,
+            text=jsonutil.dumps({"code": 400, "message": message}),
+            content_type="application/json",
+        )
+
+    async def handler(request: web.Request):
+        try:
+            body = jsonutil.loads(await request.text() or "{}")
+            if not isinstance(body, dict):
+                return bad_request("body must be a JSON object")
+            overrides = {
+                str(judge): float(w)
+                for judge, w in (body.get("weight_overrides") or {}).items()
+            }
+            ids = body.get("ids")
+            if ids is not None:
+                if not isinstance(ids, list):
+                    return bad_request("`ids` must be a list")
+                unknown = [
+                    cid for cid in ids if store.score_completion(cid) is None
+                ]
+                if unknown:
+                    return bad_request(
+                        f"unknown score completion ids: {unknown[:5]}"
+                    )
+            revote = bool(body.get("revote", False))
+            apply = bool(body.get("apply", False))
+            include = bool(body.get("include_results", False))
+        except (TypeError, ValueError, SchemaError) as e:
+            return bad_request(str(e))
+
+        def run():
+            results = rescore_archive(
+                store,
+                mesh=mesh,
+                weight_overrides=overrides or None,
+                ids=ids,
+                revote=revote,
+            )
+            applied = apply_rescore(store, results) if apply else 0
+            return results, applied
+
+        # the lock serializes archive mutations (apply writes into live
+        # wire objects other handlers read)
+        async with lock:
+            results, applied = (
+                await asyncio.get_running_loop().run_in_executor(None, run)
+            )
+        out = {"rescored": len(results), "applied": applied}
+        if include:
+            out["results"] = results
+        return web.Response(
+            text=jsonutil.dumps(out), content_type="application/json"
+        )
+
+    return handler
+
+
+def _learn_handler(store, embedder, tables, lock):
     """POST /weights/learn: build training-table rows from the archive.
 
     Body: {"model": <inline panel JSON>, "labels": {completion_id: correct
     candidate index}?, "ids": [completion ids]?}.  Runs on an executor (it
     embeds prompts on device) and returns {"rows_added": N}.  Idempotent —
-    already-ingested completions are skipped.
+    already-ingested completions are skipped.  The shared lock serializes
+    learn passes against each other (both would pass the is_ingested check
+    before either marks) and against archive mutations (rescore apply).
     """
-    import asyncio
-
     from ..identity.model import ModelBase
     from ..types.base import SchemaError
     from ..utils import jsonutil
     from ..weights.learning import populate_from_archive
-
-    # serialize learn passes: two overlapping POSTs would both pass the
-    # is_ingested check before either marks, duplicating rows
-    lock = asyncio.Lock()
 
     async def handler(request: web.Request):
         try:
@@ -287,10 +358,19 @@ def build_service(config: Config, fake_upstream: bool = False):
         profile_dir=config.profile_dir,
     )
     app[ARCHIVE_KEY] = store
+    # one lock for every handler that mutates the archive/tables
+    archive_lock = asyncio.Lock()
+    app.router.add_post(
+        "/archive/rescore",
+        _rescore_handler(
+            store, archive_lock, mesh=getattr(embedder, "mesh", None)
+        ),
+    )
     if tables is not None:
         app[TABLES_KEY] = tables
         app.router.add_post(
-            "/weights/learn", _learn_handler(store, embedder, tables)
+            "/weights/learn",
+            _learn_handler(store, embedder, tables, archive_lock),
         )
     if config.archive_path:
         path = config.archive_path

@@ -33,7 +33,9 @@ TPU additions:
   (with per-judge ballots and the originating score request, enabling
   logprob re-extraction and training-table learning), making its id
   referenceable in later requests.  Defaults on when ``ARCHIVE_PATH`` is
-  set; ``ARCHIVE_WRITE=0`` disables.
+  set; ``ARCHIVE_WRITE=0`` disables.  ``POST /archive/rescore`` re-tallies
+  archived completions on device (weight overrides, optional logprob
+  revote, optional write-back).
 * ``TABLES_PATH`` — .npz snapshot for the judge training tables: loaded
   at startup when present, saved on graceful shutdown.  With an embedder
   configured, ``POST /weights/learn`` builds rows from the archive into

@@ -807,3 +807,113 @@ def test_archive_write_config_defaults():
     assert bare.archive_write is False
     explicit = Config.from_env({"ARCHIVE_WRITE": "1"})
     assert explicit.archive_write is True
+
+
+def test_archive_rescore_endpoint():
+    """POST /archive/rescore: reweight archived completions over HTTP,
+    apply back into the store."""
+    from llm_weighted_consensus_tpu.serve.__main__ import (
+        ARCHIVE_KEY,
+        build_service,
+    )
+    from llm_weighted_consensus_tpu.utils import jsonutil
+
+    config = Config.from_env(
+        {"OPENAI_API_BASE": "https://up.example", "OPENAI_API_KEY": "k"}
+    )
+    app = build_service(config)
+    store = app[ARCHIVE_KEY]
+
+    # seed two archived score completions via the real engine
+    keys = ballot_keys(2)
+    transport = FakeTransport(
+        [
+            Script([chunk_obj(f"pick {keys[0]}", model="ja", finish="stop")]),
+            Script([chunk_obj(f"pick {keys[1]}", model="jb", finish="stop")]),
+        ]
+    )
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    score = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=store,
+        rng_factory=lambda: random.Random(SEED),
+    )
+    from llm_weighted_consensus_tpu.types.score_request import (
+        ChatCompletionCreateParams as SP,
+    )
+
+    model = inline_model([{"model": "ja"}, {"model": "jb"}])
+    result = go(
+        score.create_unary(
+            None,
+            SP.from_json_obj(
+                {
+                    "messages": [{"role": "user", "content": "q"}],
+                    "model": model,
+                    "choices": ["a", "b"],
+                }
+            ),
+        )
+    )
+    store.put_score(result)
+    judge_ids = sorted({c.model for c in result.choices if c.model})
+
+    async def run(client):
+        resp = await client.post(
+            "/archive/rescore",
+            data=jsonutil.dumps(
+                {
+                    "weight_overrides": {judge_ids[0]: 3.0},
+                    "apply": True,
+                    "include_results": True,
+                }
+            ),
+            headers={"content-type": "application/json"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["rescored"] == 1
+        assert body["applied"] == 1
+        conf = [float(x) for x in body["results"][result.id]["confidence"]]
+        assert conf[0] + conf[1] == pytest.approx(1.0)
+        assert 0.75 in [pytest.approx(c) for c in conf]
+
+    go(with_client(app, run))
+    # applied back into the archived wire object
+    cand = {c.index: c for c in store._score[result.id].choices if c.index < 2}
+    assert {float(cand[0].confidence), float(cand[1].confidence)} == {
+        0.75,
+        0.25,
+    }
+
+
+def test_archive_rescore_endpoint_validates_input():
+    from llm_weighted_consensus_tpu.serve.__main__ import build_service
+
+    config = Config.from_env(
+        {"OPENAI_API_BASE": "https://up.example", "OPENAI_API_KEY": "k"}
+    )
+    app = build_service(config)
+
+    async def run(client):
+        hdr = {"content-type": "application/json"}
+        resp = await client.post(
+            "/archive/rescore", data=b'{"ids": ["nope"]}', headers=hdr
+        )
+        assert resp.status == 400
+        assert "unknown" in (await resp.json())["message"]
+        resp = await client.post(
+            "/archive/rescore", data=b'{"ids": "abc"}', headers=hdr
+        )
+        assert resp.status == 400
+        resp = await client.post("/archive/rescore", data=b"[]", headers=hdr)
+        assert resp.status == 400
+        # empty body = rescore everything (empty archive -> 0)
+        resp = await client.post("/archive/rescore", data=b"{}", headers=hdr)
+        assert resp.status == 200
+        assert (await resp.json())["rescored"] == 0
+
+    go(with_client(app, run))

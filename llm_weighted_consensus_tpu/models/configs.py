@@ -71,6 +71,19 @@ GTE_LARGE = BertConfig(
     pooling="mean",
 )
 
+# Long-context encoder (bge-large dims, 8192-position table): serve with
+# MESH_SP so attention runs as a sequence-parallel ring — a single device
+# would need the full (s, s) score matrix.  No public checkpoint ships
+# with these positions; load fine-tuned weights via EMBEDDER_WEIGHTS or
+# train with train/ (position_embed rows beyond 512 train from scratch).
+BERT_LONG_8K = BertConfig(
+    hidden_size=1024,
+    num_layers=24,
+    num_heads=16,
+    intermediate_size=4096,
+    max_position_embeddings=8192,
+)
+
 # tiny config for tests: fast init/compile on the CPU mesh
 TEST_TINY = BertConfig(
     vocab_size=512,
@@ -91,6 +104,7 @@ PRESETS = {
     "gte-small": GTE_SMALL,
     "gte-base": GTE_BASE,
     "gte-large": GTE_LARGE,
+    "bert-long-8k": BERT_LONG_8K,
     "test-tiny": TEST_TINY,
 }
 

@@ -11,26 +11,32 @@ from jax.sharding import Mesh
 
 
 def make_mesh(
-    dp: Optional[int] = None, tp: int = 1, devices: Optional[list] = None
+    dp: Optional[int] = None,
+    tp: int = 1,
+    devices: Optional[list] = None,
+    names: tuple = ("dp", "tp"),
 ) -> Mesh:
-    """A (dp, tp) mesh over the available devices.
+    """A 2-axis mesh over the available devices (axis names default to
+    (dp, tp); sequence-parallel serving reuses this with ("dp", "sp")).
 
-    ``dp=None`` takes every device not consumed by ``tp``.  On real slices
-    the device order from ``jax.devices()`` follows the ICI torus, so
-    neighboring tp groups ride the fastest links.
+    ``dp=None`` takes every device not consumed by the inner axis.  On
+    real slices the device order from ``jax.devices()`` follows the ICI
+    torus, so neighboring inner-axis groups ride the fastest links.
     """
     devices = list(devices if devices is not None else jax.devices())
     if dp is None:
         dp = len(devices) // tp
     if dp < 1 or tp < 1:
-        raise ValueError(f"mesh axes must be >= 1, got dp={dp} tp={tp}")
+        raise ValueError(
+            f"mesh axes must be >= 1, got {names[0]}={dp} {names[1]}={tp}"
+        )
     n = dp * tp
     if n > len(devices):
         raise ValueError(
             f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}"
         )
     grid = np.array(devices[:n]).reshape(dp, tp)
-    return Mesh(grid, ("dp", "tp"))
+    return Mesh(grid, names)
 
 
 def data_parallel_mesh(n: Optional[int] = None) -> Mesh:

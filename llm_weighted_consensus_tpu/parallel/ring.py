@@ -201,3 +201,31 @@ def ring_embed(
             pooling,
             normalize,
         )
+
+
+def shard_embedder_sp(
+    embedder, mesh: Mesh, sp_axis: str = "sp", dp_axis=None
+) -> None:
+    """Wire a ``TpuEmbedder`` for sequence-parallel serving: its embedding
+    forwards route through ``ring_embed`` over ``mesh`` (sequences padded
+    to an sp multiple), enabling long-context inputs whose attention would
+    not fit one device.  Consensus-vote fused paths keep their single-
+    device dispatch (self-consistency candidates are short by contract);
+    this serves the /embeddings + trained-weights lookup paths."""
+    import dataclasses
+
+    sp = mesh.shape[sp_axis]
+    embedder.sp_mesh = mesh
+    embedder.sp_axis = sp_axis
+    embedder.sp_dp_axis = dp_axis
+    # batches pad to a dp multiple (same contract as shard_embedder)
+    embedder.batch_multiple = mesh.shape[dp_axis] if dp_axis else 1
+    # the sequence pads to an sp multiple before dispatch; cap the token
+    # window so padding can never push past the position table
+    embedder.max_tokens = min(
+        embedder.max_tokens,
+        (embedder.config.max_position_embeddings // sp) * sp,
+    )
+    embedder.ring_config = dataclasses.replace(
+        embedder.config, attention_impl="ring", ring_axis=sp_axis
+    )

@@ -230,7 +230,28 @@ def build_embedder(config: Config):
         tokenizer=load_tokenizer(vocab_path) if vocab_path else None,
         max_tokens=config.embedder_max_tokens,
     )
-    if config.mesh_dp is not None or config.mesh_tp > 1:
+    if config.mesh_sp is not None:
+        import jax
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.ring import shard_embedder_sp
+
+        if config.mesh_tp > 1:
+            raise ValueError(
+                "MESH_SP and MESH_TP are mutually exclusive (sequence "
+                "parallelism replicates encoder params)"
+            )
+        dp = config.mesh_dp or 1
+        mesh = make_mesh(
+            dp=dp,
+            tp=config.mesh_sp,
+            devices=jax.local_devices(),
+            names=("dp", "sp"),
+        )
+        shard_embedder_sp(
+            embedder, mesh, dp_axis="dp" if dp > 1 else None
+        )
+    elif config.mesh_dp is not None or config.mesh_tp > 1:
         import jax
 
         from ..parallel.mesh import make_mesh

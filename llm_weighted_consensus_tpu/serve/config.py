@@ -21,6 +21,11 @@ TPU additions:
   mesh: batches shard over ``dp``, encoder params Megatron-split over
   ``tp`` (parallel/sharding.py).  Unset = single device.  ``MESH_DP``
   empty + ``MESH_TP=n`` uses every device not consumed by tp for dp.
+* ``MESH_SP`` — sequence parallelism: embedding forwards run as ring
+  attention over an sp-way mesh (parallel/ring.py), enabling long-context
+  inputs (e.g. ``EMBEDDER_MODEL=bert-long-8k``).  Combines with
+  ``MESH_DP`` (batch x sequence grid); mutually exclusive with
+  ``MESH_TP``.
 * ``MULTIHOST`` — set to 1 on each host of a multi-host slice to call
   ``jax.distributed.initialize`` before mesh construction (parallel/dist.py).
 * ``PROFILE_DIR`` — arms ``POST /profile/start`` / ``POST /profile/stop``:
@@ -98,6 +103,7 @@ class Config:
     embedder_max_tokens: int = 512
     mesh_dp: Optional[int] = None
     mesh_tp: int = 1
+    mesh_sp: Optional[int] = None
     profile_dir: Optional[str] = None
     archive_path: Optional[str] = None
     archive_write: bool = False
@@ -151,6 +157,7 @@ class Config:
             embedder_max_tokens=int(env.get("EMBEDDER_MAX_TOKENS", 512)),
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
             mesh_tp=int(env.get("MESH_TP", 1)),
+            mesh_sp=int(env["MESH_SP"]) if env.get("MESH_SP") else None,
             profile_dir=env.get("PROFILE_DIR"),
             archive_path=env.get("ARCHIVE_PATH"),
             archive_write=(

@@ -176,3 +176,101 @@ def test_ring_rejects_sequence_beyond_position_table():
     einsum_config = dataclasses.replace(TEST_TINY, attention_impl="einsum")
     with pytest.raises(ValueError, match="max_position_embeddings"):
         bert.encode(params, ids, jnp.ones_like(ids), einsum_config)
+
+
+# -- sequence-parallel serving wiring ----------------------------------------
+
+
+def test_shard_embedder_sp_matches_plain_embedder():
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    plain = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=64, seed=2)
+    ringed = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=64, seed=2)
+    ring.shard_embedder_sp(ringed, sp_mesh(8))
+    texts = [
+        "a longer text with many words " * 2,
+        "short",
+        "and a third document",
+    ]
+    np.testing.assert_allclose(
+        ringed.embed_texts(texts), plain.embed_texts(texts), atol=1e-4
+    )
+
+
+def test_build_embedder_mesh_sp_round_trip():
+    from llm_weighted_consensus_tpu.serve import Config
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    config = Config.from_env(
+        {
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_MAX_TOKENS": "64",
+            "MESH_SP": "4",
+            "MESH_DP": "2",
+        }
+    )
+    embedder = build_embedder(config)
+    assert embedder.sp_mesh is not None
+    assert dict(embedder.sp_mesh.shape) == {"dp": 2, "sp": 4}
+    out = embedder.embed_texts(["long context through the ring"])
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, atol=1e-5)
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        build_embedder(
+            Config.from_env(
+                {
+                    "EMBEDDER_MODEL": "test-tiny",
+                    "MESH_SP": "4",
+                    "MESH_TP": "2",
+                }
+            )
+        )
+
+
+def test_long_context_preset_exists():
+    from llm_weighted_consensus_tpu.models.configs import PRESETS
+
+    cfg = PRESETS["bert-long-8k"]
+    assert cfg.max_position_embeddings == 8192
+    assert cfg.hidden_size == 1024
+
+
+def test_sp_serving_edge_configs():
+    """Reviewer repros: non-power-of-two dp divides via batch_multiple;
+    sp that does not divide the position table caps max_tokens; sp=0 is a
+    clean config error."""
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+    from llm_weighted_consensus_tpu.serve import Config
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    # dp=3 x sp=2 on 6 devices: batch pads to a dp multiple, not a crash
+    import numpy as np
+
+    from jax.sharding import Mesh
+
+    emb = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=64, seed=2)
+    mesh = Mesh(np.array(jax.devices()[:6]).reshape(3, 2), ("dp", "sp"))
+    ring.shard_embedder_sp(emb, mesh, dp_axis="dp")
+    assert emb.batch_multiple == 3
+    plain = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=64, seed=2)
+    texts = ["one", "two", "three", "four"]  # 4 texts, pads to 18 rows
+    np.testing.assert_allclose(
+        emb.embed_texts(texts), plain.embed_texts(texts), atol=1e-4
+    )
+
+    # sp=3 does not divide max_pos 64: window capped to 63, full-length
+    # inputs still embed (never 500)
+    emb3 = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=64, seed=2)
+    mesh3 = Mesh(np.array(jax.devices()[:3]).reshape(1, 3), ("dp", "sp"))
+    ring.shard_embedder_sp(emb3, mesh3)
+    assert emb3.max_tokens == 63
+    out = emb3.embed_texts(["word " * 200])  # truncates, embeds, no error
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, atol=1e-5)
+
+    # sp=0 is rejected at build time with a clear error
+    with pytest.raises(ValueError, match="axes must be >= 1"):
+        build_embedder(
+            Config.from_env(
+                {"EMBEDDER_MODEL": "test-tiny", "MESH_SP": "0"}
+            )
+        )

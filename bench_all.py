@@ -30,7 +30,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
 
-from bench import make_requests, tokenize_fixed  # noqa: E402
+from bench import bench_tokenizer, make_requests, tokenize_fixed  # noqa: E402
 
 
 def emit(config: int, metric: str, value: float, unit: str, **extra) -> None:
@@ -58,7 +58,9 @@ def bench_self_consistency(
     from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
 
     dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
-    embedder = TpuEmbedder(model, max_tokens=seq, dtype=dtype)
+    embedder = TpuEmbedder(
+        model, max_tokens=seq, dtype=dtype, tokenizer=bench_tokenizer()
+    )
     reqs = make_requests(requests, n)
 
     def consensus(texts):
@@ -138,7 +140,10 @@ def bench_multichat_weighted(n: int, backends: int, requests: int) -> None:
     )
 
     dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
-    embedder = TpuEmbedder("bge-large-en", max_tokens=128, dtype=dtype)
+    embedder = TpuEmbedder(
+        "bge-large-en", max_tokens=128, dtype=dtype,
+        tokenizer=bench_tokenizer(),
+    )
     model = _make_panel(n, backends)
     params = ChatCompletionCreateParams.from_json_obj(
         {
@@ -290,7 +295,10 @@ def bench_streaming_incremental(n: int, requests: int) -> None:
     )
 
     dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
-    embedder = TpuEmbedder("bge-large-en", max_tokens=128, dtype=dtype)
+    embedder = TpuEmbedder(
+        "bge-large-en", max_tokens=128, dtype=dtype,
+        tokenizer=bench_tokenizer(),
+    )
     model = _make_panel(n, 3)
     params = ChatCompletionCreateParams.from_json_obj(
         {

@@ -121,12 +121,11 @@ class TpuEmbedder:
         # batches are padded up to a multiple of this before dispatch so
         # the dp split divides evenly (shard_embedder sets it to dp)
         self.batch_multiple = 1
-        # sequence-parallel serving (parallel.ring.shard_embedder_sp):
-        # when set, embedding forwards ride ring attention over this mesh
-        self.sp_mesh = None
-        self.sp_axis = "sp"
-        self.sp_dp_axis = None
-        self.ring_config = None
+        # forward-override hook: a shard function (e.g.
+        # parallel.ring.shard_embedder_sp) may replace the whole embedding
+        # forward — (padded ids, mask) -> embeddings — keeping this module
+        # parallelism-agnostic
+        self.embed_override = None
 
     # -- core ----------------------------------------------------------------
 
@@ -163,28 +162,8 @@ class TpuEmbedder:
         if pad_b != b:
             ids = np.pad(ids, ((0, pad_b - b), (0, 0)))
             mask = np.pad(mask, ((0, pad_b - b), (0, 0)))
-        if self.sp_mesh is not None:
-            from ..parallel.ring import ring_embed
-
-            # sequence-parallel forward: pad seq to an sp multiple (pads
-            # are masked keys — attention ignores them)
-            sp = self.sp_mesh.shape[self.sp_axis]
-            pad_s = (-ids.shape[1]) % sp
-            if pad_s:
-                ids = np.pad(ids, ((0, 0), (0, pad_s)))
-                mask = np.pad(mask, ((0, 0), (0, pad_s)))
-            emb = ring_embed(
-                self.params,
-                ids,
-                mask,
-                self.ring_config,
-                self.sp_mesh,
-                sp_axis=self.sp_axis,
-                dp_axis=self.sp_dp_axis,
-                pooling=self.pooling,
-                normalize=True,
-            )
-            return np.asarray(emb[:b])
+        if self.embed_override is not None:
+            return np.asarray(self.embed_override(ids, mask)[:b])
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
         emb = bert.embed(
             self.params,

@@ -24,12 +24,12 @@ def make_mesh(
     torus, so neighboring inner-axis groups ride the fastest links.
     """
     devices = list(devices if devices is not None else jax.devices())
+    if tp < 1:  # before the auto-fill division below
+        raise ValueError(f"mesh axes must be >= 1, got {names[1]}={tp}")
     if dp is None:
         dp = len(devices) // tp
-    if dp < 1 or tp < 1:
-        raise ValueError(
-            f"mesh axes must be >= 1, got {names[0]}={dp} {names[1]}={tp}"
-        )
+    if dp < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {names[0]}={dp}")
     n = dp * tp
     if n > len(devices):
         raise ValueError(

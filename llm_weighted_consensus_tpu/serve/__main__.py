@@ -222,13 +222,22 @@ def build_embedder(config: Config):
         )
         if not vocab_path:
             vocab_path = find_vocab(config.embedder_weights)
+    max_tokens = config.embedder_max_tokens
+    if max_tokens is None:
+        # MESH_SP exists to serve long inputs — defaulting to 512 would
+        # silently truncate exactly the documents it's configured for
+        max_tokens = (
+            PRESETS[config.embedder_model].max_position_embeddings
+            if config.mesh_sp is not None
+            else 512
+        )
     embedder = TpuEmbedder(
         config.embedder_model,
         params=params,
         # only override the tokenizer when a real vocab is available;
         # TpuEmbedder's default hash fallback sizes to the model vocab
         tokenizer=load_tokenizer(vocab_path) if vocab_path else None,
-        max_tokens=config.embedder_max_tokens,
+        max_tokens=max_tokens,
     )
     if config.mesh_sp is not None:
         import jax
@@ -241,13 +250,15 @@ def build_embedder(config: Config):
                 "MESH_SP and MESH_TP are mutually exclusive (sequence "
                 "parallelism replicates encoder params)"
             )
-        dp = config.mesh_dp or 1
+        # MESH_DP unset = auto-fill (every device not consumed by sp),
+        # matching the documented dp/tp semantics
         mesh = make_mesh(
-            dp=dp,
+            dp=config.mesh_dp,
             tp=config.mesh_sp,
             devices=jax.local_devices(),
             names=("dp", "sp"),
         )
+        dp = mesh.shape["dp"]
         shard_embedder_sp(
             embedder, mesh, dp_axis="dp" if dp > 1 else None
         )

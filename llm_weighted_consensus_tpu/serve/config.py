@@ -16,7 +16,9 @@ TPU additions:
 * ``EMBEDDER_VOCAB``  — path to a WordPiece ``vocab.txt``; defaults to
   the vocab.txt beside EMBEDDER_WEIGHTS when present, else hash-tokenizer
   fallback.
-* ``EMBEDDER_MAX_TOKENS`` — truncation window (default 512).
+* ``EMBEDDER_MAX_TOKENS`` — truncation window.  Default: the model's full
+  position table under ``MESH_SP`` (long-context serving must not silently
+  truncate), else 512.
 * ``MESH_DP`` / ``MESH_TP`` — serve the embedder over a (dp, tp) device
   mesh: batches shard over ``dp``, encoder params Megatron-split over
   ``tp`` (parallel/sharding.py).  Unset = single device.  ``MESH_DP``
@@ -100,7 +102,7 @@ class Config:
     embedder_model: Optional[str] = None  # e.g. "bge-small-en"
     embedder_weights: Optional[str] = None  # local checkpoint path
     embedder_vocab: Optional[str] = None  # path to vocab.txt
-    embedder_max_tokens: int = 512
+    embedder_max_tokens: Optional[int] = None  # None = context-aware default
     mesh_dp: Optional[int] = None
     mesh_tp: int = 1
     mesh_sp: Optional[int] = None
@@ -154,7 +156,11 @@ class Config:
             embedder_model=env.get("EMBEDDER_MODEL"),
             embedder_weights=env.get("EMBEDDER_WEIGHTS"),
             embedder_vocab=env.get("EMBEDDER_VOCAB"),
-            embedder_max_tokens=int(env.get("EMBEDDER_MAX_TOKENS", 512)),
+            embedder_max_tokens=(
+                int(env["EMBEDDER_MAX_TOKENS"])
+                if env.get("EMBEDDER_MAX_TOKENS")
+                else None
+            ),
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
             mesh_tp=int(env.get("MESH_TP", 1)),
             mesh_sp=int(env["MESH_SP"]) if env.get("MESH_SP") else None,

@@ -274,3 +274,18 @@ def test_sp_serving_edge_configs():
                 {"EMBEDDER_MODEL": "test-tiny", "MESH_SP": "0"}
             )
         )
+
+
+def test_mesh_sp_autofill_dp_and_long_default_window():
+    from llm_weighted_consensus_tpu.serve import Config
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    # MESH_DP unset -> every device not consumed by sp becomes dp
+    config = Config.from_env(
+        {"EMBEDDER_MODEL": "test-tiny", "MESH_SP": "2"}
+    )
+    embedder = build_embedder(config)
+    assert dict(embedder.sp_mesh.shape) == {"dp": 4, "sp": 2}
+    # EMBEDDER_MAX_TOKENS unset under MESH_SP -> full position table
+    # (test-tiny: 64), NOT the 512 short-context default
+    assert embedder.max_tokens == 64

@@ -260,6 +260,11 @@ def main() -> int:
                 "model": args.model,
                 "backend": backend,
                 "requests": len(requests),
+                "numerics": (
+                    "exact erf GELU (HF-checkpoint parity, "
+                    "tests/test_hf_parity.py); r1's 31/s used the tanh "
+                    "approximation, which diverges from real checkpoints"
+                ),
             }
         )
     )

@@ -126,6 +126,8 @@ class TpuEmbedder:
         # forward — (padded ids, mask) -> embeddings — keeping this module
         # parallelism-agnostic
         self.embed_override = None
+        # introspection: the sequence-parallel mesh when sp-sharded
+        self.sp_mesh = None
 
     # -- core ----------------------------------------------------------------
 

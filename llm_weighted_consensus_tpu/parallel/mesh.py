@@ -29,7 +29,12 @@ def make_mesh(
     if dp is None:
         dp = len(devices) // tp
     if dp < 1:
-        raise ValueError(f"mesh axes must be >= 1, got {names[0]}={dp}")
+        # include the other axis: an auto-filled dp=0 means the INNER axis
+        # exceeded the device count, which is the user's actual mistake
+        raise ValueError(
+            f"mesh axes must be >= 1, got {names[0]}={dp} {names[1]}={tp} "
+            f"over {len(devices)} devices"
+        )
     n = dp * tp
     if n > len(devices):
         raise ValueError(

@@ -202,10 +202,25 @@ async def _fake_upstream(request: web.Request) -> web.StreamResponse:
     return resp
 
 
+def _enable_compile_cache(path: str) -> None:
+    """Persistent XLA compilation cache: warm restarts skip the
+    first-request compile (SURVEY §7 'cold-start/compile caching').
+    Must run before the first jit compilation."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every specialization, not only slow ones — the serving loop
+    # has a handful of bucketed shapes and all of them matter cold
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
 def build_embedder(config: Config):
     """The service's device side: an embedder from env config, placed on a
     (dp, tp) mesh when MESH_DP / MESH_TP are set (batches shard over dp,
     encoder params Megatron-split over tp — parallel/sharding.py)."""
+    if config.compile_cache_dir:
+        _enable_compile_cache(config.compile_cache_dir)
     if not config.embedder_model:
         return None
     from ..models.configs import PRESETS

@@ -30,6 +30,10 @@ TPU additions:
   ``MESH_TP``.
 * ``MULTIHOST`` — set to 1 on each host of a multi-host slice to call
   ``jax.distributed.initialize`` before mesh construction (parallel/dist.py).
+* ``COMPILE_CACHE_DIR`` — persistent XLA compilation cache: jit
+  specializations compiled on previous runs load from disk, cutting
+  cold-start latency (first-request compiles take tens of seconds for
+  large encoders).  Unset = in-memory cache only.
 * ``PROFILE_DIR`` — arms ``POST /profile/start`` / ``POST /profile/stop``:
   JAX profiler traces (xprof format, viewable in TensorBoard/xprof) are
   written under this directory.  Unset = endpoints disabled (404).
@@ -106,6 +110,7 @@ class Config:
     mesh_dp: Optional[int] = None
     mesh_tp: int = 1
     mesh_sp: Optional[int] = None
+    compile_cache_dir: Optional[str] = None
     profile_dir: Optional[str] = None
     archive_path: Optional[str] = None
     archive_write: bool = False
@@ -164,6 +169,7 @@ class Config:
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
             mesh_tp=int(env.get("MESH_TP", 1)),
             mesh_sp=int(env["MESH_SP"]) if env.get("MESH_SP") else None,
+            compile_cache_dir=env.get("COMPILE_CACHE_DIR"),
             profile_dir=env.get("PROFILE_DIR"),
             archive_path=env.get("ARCHIVE_PATH"),
             archive_write=(

@@ -917,3 +917,48 @@ def test_archive_rescore_endpoint_validates_input():
         assert (await resp.json())["rescored"] == 0
 
     go(with_client(app, run))
+
+
+def test_compile_cache_dir_populates(tmp_path):
+    """COMPILE_CACHE_DIR: jit specializations persist to disk so warm
+    restarts skip the cold compile."""
+    pytest.importorskip("jax")
+    import dataclasses
+    import os
+
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+    from llm_weighted_consensus_tpu.serve.__main__ import (
+        _enable_compile_cache,
+    )
+
+    import jax
+
+    cache = str(tmp_path / "xla-cache")
+    assert Config.from_env(
+        {"COMPILE_CACHE_DIR": cache}
+    ).compile_cache_dir == cache
+    saved = {
+        name: getattr(jax.config, name)
+        for name in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    try:
+        _enable_compile_cache(cache)
+        # a config shape nothing else in the suite compiles, so this is
+        # a FRESH compilation (an in-memory jit cache hit writes nothing)
+        novel = dataclasses.replace(TEST_TINY, hidden_size=96, num_heads=4)
+        embedder = TpuEmbedder("test-tiny", config=novel, max_tokens=32)
+        embedder.embed_texts(["cache this compilation"])
+        files = [
+            os.path.join(r, f) for r, _, fs in os.walk(cache) for f in fs
+        ]
+        assert files, "no compilation cache entries written"
+    finally:
+        # process-global config: later tests must not write into this
+        # test's tmp dir
+        for name, value in saved.items():
+            jax.config.update(name, value)

@@ -10,7 +10,8 @@ set -euo pipefail
 PORT="${1:-5055}"
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 WORK="$(mktemp -d)"
-trap 'kill "$GW_PID" 2>/dev/null || true; rm -rf "$WORK"' EXIT
+GW_PID=""
+trap 'kill "${GW_PID:-}" 2>/dev/null || true; rm -rf "$WORK"' EXIT
 
 say() { printf '\n\033[1m== %s ==\033[0m\n' "$*"; }
 
@@ -38,7 +39,7 @@ CID=$(curl -s "localhost:$PORT/score/completions" -H 'content-type: application/
   \"messages\": [{\"role\": \"user\", \"content\": \"which answer is best?\"}],
   \"model\": $MODEL,
   \"choices\": [\"the first answer\", \"the second answer\", \"a third answer\"]
-}" | python -c 'import json,sys; d=json.load(sys.stdin); print(d["id"]); import os
+}" | python -c 'import json,sys; d=json.load(sys.stdin); print(d["id"])
 conf=[(c["index"], c.get("confidence")) for c in d["choices"] if c["index"]<3]
 print("candidate confidences:", conf, file=sys.stderr)')
 echo "archived as: $CID"

@@ -3,9 +3,13 @@
 Two fusions that matter for serving latency (keeping intermediates in VMEM
 instead of round-tripping HBM between XLA ops):
 
-* ``fused_consensus``    — weights x votes matmul + normalize in one pass;
 * ``fused_cosine_vote``  — l2-normalize + pairwise cosine + mean-off-diag +
-  masked softmax in one pass (the whole self-consistency scorer).
+  masked softmax in one pass (the whole self-consistency scorer); the
+  serving hot path's scorer (models/embedder.py, clients/multichat.py).
+
+(A fused tally kernel existed but was removed: the live tally is host
+Decimal by product contract and batched re-scoring uses
+``consensus.tally_batch`` — a device twin with no caller is dead weight.)
 
 On non-TPU backends the kernels run in interpret mode (same code path, same
 results) so the CPU test mesh exercises them; beyond the single-block VMEM
@@ -38,46 +42,6 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     pad = [(0, 0)] * x.ndim
     pad[axis] = (0, target - size)
     return jnp.pad(x, pad)
-
-
-# ---------------------------------------------------------------------------
-# Fused tally + normalize
-# ---------------------------------------------------------------------------
-
-
-def _consensus_kernel(weights_ref, votes_ref, out_ref):
-    # [1, M] x [M, N] on the MXU, then VPU normalize — one VMEM residency
-    cw = jnp.dot(
-        weights_ref[:], votes_ref[:], preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST
-    )  # [1, N]
-    total = jnp.sum(cw)
-    out_ref[:] = jnp.where(total > 0, cw / total, 0.0)
-
-
-@jax.jit
-def fused_consensus(votes: jax.Array, weights: jax.Array) -> jax.Array:
-    """votes[M, N], weights[M] -> confidence[N] in a single fused kernel.
-
-    Padding rows/cols are zero so they contribute nothing to the tally.
-    Beyond the single-block VMEM budget the jnp composition takes over.
-    """
-    m, n = votes.shape
-    # same single-block VMEM budget as fused_cosine_vote (~8 MB f32)
-    if m > MAX_FUSED_CHOICES or n > MAX_FUSED_DIM:
-        from .consensus import tally
-
-        _, confidence = tally(votes, weights)
-        return confidence
-    votes_p = _pad_to(_pad_to(votes.astype(jnp.float32), 0, 8), 1, 128)
-    weights_p = _pad_to(weights.astype(jnp.float32)[None, :], 1, 8)
-    mp, np_ = votes_p.shape
-    out = pl.pallas_call(
-        _consensus_kernel,
-        out_shape=jax.ShapeDtypeStruct((1, np_), jnp.float32),
-        interpret=_interpret(),
-    )(weights_p, votes_p)
-    return out[0, :n]
 
 
 # ---------------------------------------------------------------------------

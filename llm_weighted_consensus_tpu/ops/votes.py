@@ -42,12 +42,6 @@ def softmax_votes(
     total = jnp.sum(votes, axis=-1, keepdims=True)
     return jnp.where(total > 0, votes / total, 0.0)
 
-
-@partial(jax.jit, static_argnames=("n_choices",))
-def one_hot_votes(selected: jax.Array, n_choices: int) -> jax.Array:
-    """selected[M] (int, -1 = failed judge) -> votes[M, n_choices].
-
-    The hard-vote fallback (client.rs:1796-1798) batched: failed judges get
-    all-zero rows (their mask handles renormalization in the tally).
-    """
-    return jax.nn.one_hot(selected, n_choices, dtype=jnp.float32)
+# (a one_hot_votes twin was removed: the revote path encodes one-hot
+# fallbacks as a single logprob-0 alternative through softmax_votes —
+# exp(0)=1 normalizes to the one-hot row, no second kernel needed)

@@ -160,16 +160,6 @@ def test_softmax_votes_invalid_slots_and_empty_rows():
     np.testing.assert_array_equal(np.asarray(v[1]), 0.0)  # failed row
 
 
-def test_one_hot_votes():
-    v = votes.one_hot_votes(jnp.asarray([2, -1, 0]), 3)
-    np.testing.assert_array_equal(
-        np.asarray(v), [[0, 0, 1], [0, 0, 0], [1, 0, 0]]
-    )
-
-
-# -- similarity ---------------------------------------------------------------
-
-
 def test_pairwise_cosine_vs_numpy():
     rng = np.random.default_rng(4)
     x = rng.normal(size=(6, 32)).astype(np.float32)
@@ -221,22 +211,6 @@ def test_training_table_weights_bounds_and_direction():
 
 
 # -- fused pallas kernels -----------------------------------------------------
-
-
-@pytest.mark.parametrize("m,n", [(3, 5), (8, 128), (17, 200)])
-def test_fused_consensus_matches_jnp(m, n):
-    v = rand_votes(m, n, seed=m + n)
-    w = np.linspace(0.5, 2.0, m).astype(np.float32)
-    fused = np.asarray(kernels.fused_consensus(jnp.asarray(v), jnp.asarray(w)))
-    _, ref = consensus.tally(jnp.asarray(v), jnp.asarray(w))
-    np.testing.assert_allclose(fused, np.asarray(ref), atol=1e-6)
-
-
-def test_fused_consensus_all_zero():
-    v = np.zeros((4, 6), dtype=np.float32)
-    w = np.ones(4, dtype=np.float32)
-    fused = np.asarray(kernels.fused_consensus(jnp.asarray(v), jnp.asarray(w)))
-    assert not np.any(np.isnan(fused))
 
 
 @pytest.mark.parametrize("n,d", [(4, 32), (5, 100), (16, 384)])

@@ -180,20 +180,29 @@ def encode(
     ``position_offset`` shifts the position embeddings — used by the
     sequence-parallel forward (parallel/ring.py) where each shard holds a
     slice of the global sequence."""
+    from .configs import position_base
+
     b, s = input_ids.shape
+    base = position_base(config)
     if (
         isinstance(position_offset, int)
-        and s + position_offset > config.max_position_embeddings
+        and base + s + position_offset > config.max_position_embeddings
     ):
         # gathers clamp out-of-range indices — fail loudly instead of
         # silently reusing the last position embedding
         raise ValueError(
-            f"sequence {s} (+offset {position_offset}) exceeds "
-            f"max_position_embeddings={config.max_position_embeddings}"
+            f"sequence {s} (+offset {position_offset}, position base "
+            f"{base}) exceeds max_position_embeddings="
+            f"{config.max_position_embeddings}"
         )
     with jax.named_scope("embeddings"):
         x = params["token_embed"][input_ids]
-        x = x + params["position_embed"][jnp.arange(s) + position_offset][None, :, :]
+        # left-aligned masks make roberta's cumsum positions an arange
+        # with a base offset (pad positions get wrong embeddings but their
+        # hidden states are masked out of attention and pooling)
+        x = x + params["position_embed"][
+            jnp.arange(s) + position_offset + base
+        ][None, :, :]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = x + params["type_embed"][token_type_ids]

@@ -25,6 +25,11 @@ class BertConfig:
     # family-default pooling: bge uses CLS, e5/gte use masked mean
     # (both + l2-normalize); TpuEmbedder reads this unless overridden
     pooling: str = "cls"
+    # "absolute": positions 0..s-1 (BERT).  "roberta": positions start at
+    # pad_token_id+1 (XLM-R/RoBERTa checkpoints, e.g. bge-m3) — with the
+    # framework's left-aligned masks that is an arange offset, so the
+    # usable window is max_position_embeddings - pad_token_id - 1.
+    position_style: str = "absolute"
 
     @property
     def head_dim(self) -> int:
@@ -71,6 +76,24 @@ GTE_LARGE = BertConfig(
     pooling="mean",
 )
 
+# BGE-M3 (BAAI/bge-m3 dense retrieval: XLM-RoBERTa-large arch, 8192-token
+# context, CLS pooling).  Positions are roberta-style; the 8194-row table
+# minus pad_token_id+1 gives the advertised 8192-token window.  Serve long
+# inputs with MESH_SP (ring attention).  The real checkpoint's
+# sentencepiece tokenizer is out of scope offline — configure
+# EMBEDDER_VOCAB for WordPiece or accept the hash fallback for shape work.
+BGE_M3 = BertConfig(
+    vocab_size=250002,
+    hidden_size=1024,
+    num_layers=24,
+    num_heads=16,
+    intermediate_size=4096,
+    max_position_embeddings=8194,
+    type_vocab_size=1,
+    pad_token_id=1,
+    position_style="roberta",
+)
+
 # Long-context encoder (bge-large dims, 8192-position table): serve with
 # MESH_SP so attention runs as a sequence-parallel ring — a single device
 # would need the full (s, s) score matrix.  No public checkpoint ships
@@ -104,9 +127,25 @@ PRESETS = {
     "gte-small": GTE_SMALL,
     "gte-base": GTE_BASE,
     "gte-large": GTE_LARGE,
+    "bge-m3": BGE_M3,
     "bert-long-8k": BERT_LONG_8K,
     "test-tiny": TEST_TINY,
 }
+
+
+def position_base(config: BertConfig) -> int:
+    """First position id of a left-aligned sequence (0 for BERT,
+    pad_token_id+1 for roberta-style checkpoints)."""
+    return (
+        config.pad_token_id + 1
+        if config.position_style == "roberta"
+        else 0
+    )
+
+
+def usable_positions(config: BertConfig) -> int:
+    """Longest sequence the position table supports."""
+    return config.max_position_embeddings - position_base(config)
 
 
 @dataclass(frozen=True)

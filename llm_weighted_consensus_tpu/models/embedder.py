@@ -98,9 +98,11 @@ class TpuEmbedder:
         pooling: Optional[str] = None,
         seed: int = 0,
     ) -> None:
+        from .configs import usable_positions
+
         self.model_name = model
         self.config = config or PRESETS[model]
-        self.max_tokens = min(max_tokens, self.config.max_position_embeddings)
+        self.max_tokens = min(max_tokens, usable_positions(self.config))
         # family default from the config (bge: CLS, e5/gte: masked mean)
         # unless the caller overrides
         self.pooling = pooling if pooling is not None else self.config.pooling

@@ -1,7 +1,7 @@
 """Fused Pallas TPU kernels for the consensus hot path.
 
-Two fusions that matter for serving latency (keeping intermediates in VMEM
-instead of round-tripping HBM between XLA ops):
+The fusion that matters for serving latency (keeping intermediates in
+VMEM instead of round-tripping HBM between XLA ops):
 
 * ``fused_cosine_vote``  — l2-normalize + pairwise cosine + mean-off-diag +
   masked softmax in one pass (the whole self-consistency scorer); the

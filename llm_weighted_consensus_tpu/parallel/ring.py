@@ -133,13 +133,15 @@ def ring_encode(
     sp = mesh.shape[sp_axis]
     if s % sp != 0:
         raise ValueError(f"sequence {s} must divide sp={sp}")
-    if s > config.max_position_embeddings:
+    from ..models.configs import usable_positions
+
+    if s > usable_positions(config):
         # jnp gathers clamp out-of-range indices, which would silently
         # reuse the last position embedding instead of failing
         raise ValueError(
-            f"sequence {s} exceeds max_position_embeddings="
-            f"{config.max_position_embeddings}; long contexts need a "
-            "config with a matching position table"
+            f"sequence {s} exceeds the position table's usable window "
+            f"({usable_positions(config)}); long contexts need a config "
+            "with a matching position table"
         )
 
     seq_spec = P(dp_axis, sp_axis)
@@ -220,11 +222,13 @@ def shard_embedder_sp(
     sp = mesh.shape[sp_axis]
     # batches pad to a dp multiple (same contract as shard_embedder)
     embedder.batch_multiple = mesh.shape[dp_axis] if dp_axis else 1
+    from ..models.configs import usable_positions
+
     # the sequence pads to an sp multiple before dispatch; cap the token
     # window so padding can never push past the position table
     embedder.max_tokens = min(
         embedder.max_tokens,
-        (embedder.config.max_position_embeddings // sp) * sp,
+        (usable_positions(embedder.config) // sp) * sp,
     )
     ring_config = dataclasses.replace(
         embedder.config, attention_impl="ring", ring_axis=sp_axis

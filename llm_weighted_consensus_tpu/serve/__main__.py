@@ -241,8 +241,10 @@ def build_embedder(config: Config):
     if max_tokens is None:
         # MESH_SP exists to serve long inputs — defaulting to 512 would
         # silently truncate exactly the documents it's configured for
+        from ..models.configs import usable_positions
+
         max_tokens = (
-            PRESETS[config.embedder_model].max_position_embeddings
+            usable_positions(PRESETS[config.embedder_model])
             if config.mesh_sp is not None
             else 512
         )

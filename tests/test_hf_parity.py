@@ -372,3 +372,72 @@ def test_load_params_clear_errors(tmp_path):
     empty.mkdir()
     with pytest.raises(FileNotFoundError, match="neither"):
         load_params(str(empty), TINY)
+
+
+# -- XLM-R / RoBERTa position scheme (bge-m3 backbone) ------------------------
+
+
+def test_roberta_positions_match_xlm_roberta():
+    """position_style="roberta" reproduces XLMRobertaModel hidden states
+    (the bge-m3 backbone) for left-aligned masks, ragged batches included."""
+    tiny = BertConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=34,  # 32 usable after pad_token_id+1
+        type_vocab_size=1,
+        pad_token_id=1,
+        position_style="roberta",
+    )
+    hf_config = transformers.XLMRobertaConfig(
+        vocab_size=tiny.vocab_size,
+        hidden_size=tiny.hidden_size,
+        num_hidden_layers=tiny.num_layers,
+        num_attention_heads=tiny.num_heads,
+        intermediate_size=tiny.intermediate_size,
+        max_position_embeddings=tiny.max_position_embeddings,
+        type_vocab_size=1,
+        pad_token_id=1,
+        layer_norm_eps=tiny.layer_norm_eps,
+        hidden_act="gelu",
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(7)
+    hf = transformers.XLMRobertaModel(hf_config, add_pooling_layer=False)
+    hf.eval()
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = bert.from_hf_weights(state, tiny)
+
+    rng = np.random.default_rng(8)
+    b, s = 3, 16
+    ids = rng.integers(4, tiny.vocab_size, (b, s)).astype(np.int32)
+    mask = np.ones((b, s), dtype=np.int32)
+    for i, n in enumerate((16, 11, 5)):
+        ids[i, n:] = tiny.pad_token_id
+        mask[i, n:] = 0
+
+    with torch.no_grad():
+        ref = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    ours = np.asarray(
+        bert.encode(params, jnp.asarray(ids), jnp.asarray(mask), tiny)
+    )
+    real = mask.astype(bool)
+    np.testing.assert_allclose(ours[real], ref[real], atol=2e-4, rtol=1e-3)
+
+
+def test_usable_positions_and_bge_m3_preset():
+    from llm_weighted_consensus_tpu.models.configs import (
+        PRESETS,
+        usable_positions,
+    )
+
+    m3 = PRESETS["bge-m3"]
+    assert m3.position_style == "roberta"
+    assert usable_positions(m3) == 8192
+    assert usable_positions(PRESETS["bge-large-en"]) == 512

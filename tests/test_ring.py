@@ -170,7 +170,7 @@ def test_ring_rejects_sequence_beyond_position_table():
     mesh = sp_mesh(8)
     s = 128  # TEST_TINY max_position_embeddings = 64
     ids = jnp.zeros((1, s), jnp.int32)
-    with pytest.raises(ValueError, match="max_position_embeddings"):
+    with pytest.raises(ValueError, match="usable window"):
         ring.ring_encode(params, ids, jnp.ones_like(ids), ring_config, mesh)
     # the plain forward rejects it too
     einsum_config = dataclasses.replace(TEST_TINY, attention_impl="einsum")
@@ -289,3 +289,37 @@ def test_mesh_sp_autofill_dp_and_long_default_window():
     # EMBEDDER_MAX_TOKENS unset under MESH_SP -> full position table
     # (test-tiny: 64), NOT the 512 short-context default
     assert embedder.max_tokens == 64
+
+
+def test_ring_with_roberta_positions():
+    """Sequence-parallel forward composes with the roberta position scheme
+    (bge-m3 backbone): shard offsets + position base give every shard its
+    correct global positions."""
+    roberta = BertConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=66,  # 64 usable
+        type_vocab_size=1,
+        pad_token_id=1,
+        position_style="roberta",
+        attention_impl="ring",
+    )
+    full_config = dataclasses.replace(roberta, attention_impl="einsum")
+    params = bert.init_params(jax.random.PRNGKey(9), roberta)
+    rng = np.random.default_rng(10)
+    b, s = 2, 64
+    ids = jnp.asarray(rng.integers(4, 128, (b, s)), jnp.int32)
+    mask = jnp.ones((b, s), jnp.int32)
+    mesh = sp_mesh(8)
+    ringed = np.asarray(ring.ring_embed(params, ids, mask, roberta, mesh))
+    full = np.asarray(bert.embed(params, ids, mask, full_config))
+    np.testing.assert_allclose(ringed, full, atol=1e-4)
+    # the usable-window guard accounts for the position base
+    too_long = jnp.zeros((1, 72), jnp.int32)
+    with pytest.raises(ValueError, match="usable window"):
+        ring.ring_encode(
+            params, too_long, jnp.ones_like(too_long), roberta, mesh
+        )

@@ -43,7 +43,6 @@ def _rescore_handler(store, lock, mesh=None):
     archive-mutation lock.
     """
     from ..archive.rescore import apply_rescore, rescore_archive
-    from ..types.base import SchemaError
     from ..utils import jsonutil
 
     def bad_request(message):
@@ -63,21 +62,25 @@ def _rescore_handler(store, lock, mesh=None):
                 for judge, w in (body.get("weight_overrides") or {}).items()
             }
             ids = body.get("ids")
-            if ids is not None:
-                if not isinstance(ids, list):
-                    return bad_request("`ids` must be a list")
-                unknown = [
-                    cid for cid in ids if store.score_completion(cid) is None
-                ]
-                if unknown:
-                    return bad_request(
-                        f"unknown score completion ids: {unknown[:5]}"
-                    )
             revote = bool(body.get("revote", False))
             apply = bool(body.get("apply", False))
             include = bool(body.get("include_results", False))
-        except (TypeError, ValueError, SchemaError) as e:
+        except web.HTTPException:
+            raise  # e.g. 413 body-too-large must keep its status
+        except Exception as e:  # parse phase: malformed input, not a fault
             return bad_request(str(e))
+        # validation beyond parsing stays OUTSIDE the blanket except: a
+        # store fault must surface as a 500, not masquerade as a 400
+        if ids is not None:
+            if not isinstance(ids, list):
+                return bad_request("`ids` must be a list")
+            unknown = [
+                cid for cid in ids if store.score_completion(cid) is None
+            ]
+            if unknown:
+                return bad_request(
+                    f"unknown score completion ids: {unknown[:5]}"
+                )
 
         def run():
             results = rescore_archive(
@@ -117,7 +120,6 @@ def _learn_handler(store, embedder, tables, lock):
     before either marks) and against archive mutations (rescore apply).
     """
     from ..identity.model import ModelBase
-    from ..types.base import SchemaError
     from ..utils import jsonutil
     from ..weights.learning import populate_from_archive
 
@@ -132,7 +134,9 @@ def _learn_handler(store, embedder, tables, lock):
                 for cid, idx in (body.get("labels") or {}).items()
             }
             ids = body.get("ids")
-        except (KeyError, TypeError, ValueError, SchemaError) as e:
+        except web.HTTPException:
+            raise  # e.g. 413 body-too-large must keep its status
+        except Exception as e:  # parse phase: malformed input, not a fault
             return web.Response(
                 status=400,
                 text=jsonutil.dumps({"code": 400, "message": str(e)}),

@@ -15,7 +15,6 @@ from aiohttp import web
 
 from ..errors import ScoreError, StatusError, to_response_error
 from .metrics import Metrics, middleware
-from ..types.base import SchemaError
 from ..types.chat_request import ChatCompletionCreateParams as ChatParams
 from ..types.embeddings import CreateEmbeddingParams
 from ..types.multichat_request import (
@@ -73,7 +72,10 @@ def _make_handler(params_cls, create_streaming, create_unary):
         try:
             body = jsonutil.loads(await request.text())
             params = params_cls.from_json_obj(body)
-        except (ValueError, SchemaError) as e:
+        except web.HTTPException:
+            raise  # e.g. 413 body-too-large must keep its status
+        except Exception as e:  # parse phase is side-effect free: any
+            # failure here is a malformed request, never a server fault
             return web.Response(
                 status=400,
                 text=jsonutil.dumps({"code": 400, "message": str(e)}),
@@ -265,7 +267,10 @@ def _embeddings_handler(embedder, metrics=None):
             params = CreateEmbeddingParams.from_json_obj(
                 jsonutil.loads(await request.text())
             )
-        except (ValueError, SchemaError) as e:
+        except web.HTTPException:
+            raise  # e.g. 413 body-too-large must keep its status
+        except Exception as e:  # parse phase is side-effect free: any
+            # failure here is a malformed request, never a server fault
             return web.Response(
                 status=400,
                 text=jsonutil.dumps({"code": 400, "message": str(e)}),

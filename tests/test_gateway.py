@@ -962,3 +962,85 @@ def test_compile_cache_dir_populates(tmp_path):
         # test's tmp dir
         for name, value in saved.items():
             jax.config.update(name, value)
+
+
+def test_endpoints_never_500_on_malformed_bodies():
+    """Adversarial input sweep: every POST endpoint answers malformed or
+    type-confused JSON with a clean 4xx — never a 500/stack trace."""
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.serve.__main__ import build_service
+
+    config = Config.from_env(
+        {
+            "OPENAI_API_BASE": "https://up.example",
+            "OPENAI_API_KEY": "k",
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_MAX_TOKENS": "32",
+        }
+    )
+    app = build_service(config)
+
+    bodies = [
+        b"",
+        b"not json",
+        b"[]",
+        b"42",
+        b'"string"',
+        b"{}",
+        b'{"messages": 7}',
+        b'{"messages": [{"role": "nope"}]}',
+        b'{"messages": [], "model": {"llms": []}, "choices": []}',
+        b'{"messages": [{"role": "user", "content": "q"}], "model": 5, "choices": ["a", "b"]}',
+        b'{"model": {"llms": [{"model": ""}]}}',
+        b'{"input": 12}',
+        b'{"input": [1, 2, 3]}',
+        b'{"ids": {"a": 1}}',
+        b'{"labels": "x", "model": {"llms": [{"model": "j"}]}}',
+        b'{"weight_overrides": {"j": "NaN-ish"}}',
+        ('{"messages": [{"role": "user", "content": "' + "x" * 10000 + '"}]}').encode(),
+    ]
+    endpoints = [
+        "/chat/completions",
+        "/score/completions",
+        "/multichat/completions",
+        "/embeddings",
+        "/archive/rescore",
+        "/weights/learn",
+    ]
+
+    async def run(client):
+        for path in endpoints:
+            for body in bodies:
+                resp = await client.post(
+                    path,
+                    data=body,
+                    headers={"content-type": "application/json"},
+                )
+                assert resp.status < 500, (
+                    path,
+                    body[:60],
+                    resp.status,
+                    (await resp.text())[:200],
+                )
+
+    go(with_client(app, run))
+
+
+def test_oversized_body_keeps_413():
+    """aiohttp's body-too-large rejection must keep its 413 status — the
+    broad parse guard re-raises HTTPException."""
+    from aiohttp import web as aioweb
+
+    app, _ = make_app([])
+    app._client_max_size = 1024  # shrink the limit for the test
+
+    async def run(client):
+        big = b'{"messages": "' + b"x" * 4096 + b'"}'
+        resp = await client.post(
+            "/chat/completions",
+            data=big,
+            headers={"content-type": "application/json"},
+        )
+        assert resp.status == 413
+
+    go(with_client(app, run))

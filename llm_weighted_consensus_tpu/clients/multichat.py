@@ -207,13 +207,25 @@ class StreamingSelfConsistency:
     ``confidence`` tighten while slow generators are still streaming.
     """
 
+    INITIAL_CAPACITY = 16
+
     def __init__(self, embedder, temperature: float = 0.05):
         self.embedder = embedder
         self.temperature = temperature
         self.texts: dict = {}
-        self.embeddings: dict = {}  # slot -> cached vector (embed once)
         self.failed: set = set()
         self.confidence: dict = {}
+        # device-resident consensus state: embedded candidates live in a
+        # fixed-capacity buffer (grown by bucket) so every update is ONE
+        # fused embed+revote dispatch and the only fetch is the confidence
+        # vector (VERDICT r1 item 8 + link-RTT discipline)
+        self._order: list = []  # position -> slot
+        self._buf = None
+        self._valid = None
+
+    @property
+    def count(self) -> int:
+        return len(self._order)
 
     def _absorb(self, chunk: ChatCompletionChunk) -> list:
         """Fold a chunk into the text accumulators; returns the slots that
@@ -229,18 +241,52 @@ class StreamingSelfConsistency:
                 continue
             if (
                 choice.finish_reason is not None
-                and slot not in self.embeddings
+                and slot not in self._order
+                and slot not in pending
                 and slot not in self.failed
             ):
                 pending.append(slot)
         return pending
 
+    def _ensure_capacity(self) -> None:
+        import jax.numpy as jnp
+
+        hidden = self.embedder.config.hidden_size
+        if self._buf is None:
+            cap = self.INITIAL_CAPACITY
+            self._buf = jnp.zeros((cap, hidden), jnp.float32)
+            self._valid = jnp.zeros((cap,), jnp.float32)
+        elif self.count == self._buf.shape[0]:
+            grow = self._buf.shape[0]  # double (next power-of-two bucket)
+            self._buf = jnp.pad(self._buf, ((0, grow), (0, 0)))
+            self._valid = jnp.pad(self._valid, (0, grow))
+
     def _embed_slots(self, slots: list) -> None:
-        vecs = self.embedder.embed_texts(
-            [self.texts.get(s, "") for s in slots]
-        )
-        for slot, vec in zip(slots, vecs):
-            self.embeddings[slot] = vec
+        """Fold finished candidates into the device buffer; one fused
+        embed+revote dispatch per candidate, one confidence fetch total."""
+        import numpy as np
+
+        conf = None
+        for slot in slots:
+            self._ensure_capacity()
+            position = len(self._order)
+            # the update is functional (new buffers returned), so nothing
+            # commits until it succeeds: a raising embedder leaves no
+            # phantom slot behind and the candidate can retry later
+            self._buf, self._valid, conf = self.embedder.stream_vote_update(
+                self.texts.get(slot, ""),
+                self._buf,
+                self._valid,
+                position,
+                self.temperature,
+            )
+            self._order.append(slot)
+        if conf is not None and self.count >= 2:
+            host_conf = np.asarray(conf)  # the ONE fetch
+            self.confidence = {
+                slot: float(host_conf[i])
+                for i, slot in enumerate(self._order)
+            }
 
     def push_chunk(self, chunk: ChatCompletionChunk) -> Optional[dict]:
         """Returns {slot: confidence} when the distribution updates.
@@ -251,41 +297,23 @@ class StreamingSelfConsistency:
         pending = self._absorb(chunk)
         if pending:
             self._embed_slots(pending)
-        if not pending or len(self.embeddings) < 2:
+        if not pending or self.count < 2:
             return None
-        return self._recompute()
+        return dict(self.confidence)
 
     async def push_chunk_async(
         self, chunk: ChatCompletionChunk
     ) -> Optional[dict]:
-        """``push_chunk`` with the embed + revote device dispatches moved to
-        an executor thread (VERDICT r1 item 8: the blocking embed stalled
+        """``push_chunk`` with the fused embed+revote dispatch moved to an
+        executor thread (VERDICT r1 item 8: the blocking embed stalled
         the event loop on every finished candidate)."""
         pending = self._absorb(chunk)
         if not pending:
             return None
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, self._embed_slots, pending)
-        if len(self.embeddings) < 2:
+        if self.count < 2:
             return None
-        return await loop.run_in_executor(None, self._recompute)
-
-    def _recompute(self) -> dict:
-        import jax.numpy as jnp
-        import numpy as np
-
-        from ..ops.kernels import fused_cosine_vote
-
-        slots = sorted(self.embeddings)
-        vecs = np.stack([self.embeddings[s] for s in slots])
-        # ONE host fetch for the whole distribution (a float() per element
-        # costs one link round-trip each — catastrophic over a tunnel)
-        conf = np.asarray(
-            fused_cosine_vote(jnp.asarray(vecs), temperature=self.temperature)
-        )
-        self.confidence = {
-            slot: float(c) for slot, c in zip(slots, conf)
-        }
         return dict(self.confidence)
 
 

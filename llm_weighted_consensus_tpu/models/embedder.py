@@ -69,6 +69,26 @@ def _embed_and_vote_many(
         )
 
 
+@partial(
+    jax.jit, static_argnames=("config", "pooling")
+)
+def _stream_vote_update(
+    params, ids, mask, buf, valid, position, config, pooling, temperature
+):
+    """One streaming-consensus step on device: embed ids[1, S], write the
+    vector into buf[position], set valid[position], masked revote.  The
+    capacity (buf.shape[0]) is the only streaming-dependent shape, so the
+    jit specializes per capacity bucket, not per candidate count."""
+    from ..ops.similarity import masked_cosine_vote
+
+    vec = bert.embed(params, ids, mask, config, pooling=pooling)[0]
+    buf = buf.at[position].set(vec.astype(buf.dtype))
+    valid = valid.at[position].set(1.0)
+    with jax.named_scope("stream_masked_vote"):
+        conf = masked_cosine_vote(buf, valid, temperature)
+    return buf, valid, conf
+
+
 def _bucket(n: int, cap: int) -> int:
     """Next power of two >= n (min 16), capped."""
     size = 16
@@ -232,6 +252,33 @@ class TpuEmbedder:
     def token_count(self, texts: list, max_tokens: Optional[int] = None) -> int:
         _, mask = self.tokenize(texts, max_tokens)
         return int(mask.sum())
+
+    def stream_vote_update(
+        self,
+        text: str,
+        buf,
+        valid,
+        position: int,
+        temperature: float = 0.05,
+    ):
+        """Streaming-consensus step: embed ONE new candidate into slot
+        ``position`` of the device-resident buffer and recompute the
+        masked consensus vote — embed + revote fused in ONE dispatch, so a
+        live stream pays one link round-trip per finished candidate
+        instead of two.  Returns (buf, valid, confidence[CAP]); buf/valid
+        stay on device, fetch only the confidence."""
+        ids, mask = self.tokenize([text])
+        return _stream_vote_update(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(mask),
+            buf,
+            valid,
+            position,
+            self.config,
+            self.pooling,
+            temperature,
+        )
 
     # -- wire contract --------------------------------------------------------
 

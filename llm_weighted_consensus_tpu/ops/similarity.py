@@ -64,6 +64,33 @@ def cosine_consensus_vote(
     return jax.nn.softmax(mean_sim / temperature)
 
 
+@jax.jit
+def masked_cosine_vote(
+    embeddings: jax.Array, valid: jax.Array, temperature: float = 0.05
+) -> jax.Array:
+    """``cosine_consensus_vote`` over a FIXED-capacity buffer with a
+    dynamic valid mask: embeddings[CAP, D], valid[CAP] (1.0 = live row)
+    -> confidence[CAP], softmax over valid rows only (invalid rows 0).
+
+    The streaming-consensus state keeps one device-resident buffer and
+    flips mask bits as candidates finish, so the jit specializes per
+    capacity bucket instead of per candidate count — and the embed +
+    revote fuse into ONE dispatch per update (one link round-trip).
+    """
+    valid = valid.astype(jnp.float32)
+    sims = pairwise_cosine(embeddings).astype(jnp.float32)
+    pair = valid[:, None] * valid[None, :]
+    cap = sims.shape[0]
+    off_diag = pair * (1.0 - jnp.eye(cap, dtype=sims.dtype))
+    n_valid = jnp.sum(valid)
+    mean_sim = jnp.sum(sims * off_diag, axis=-1) / jnp.maximum(
+        n_valid - 1.0, 1.0
+    )
+    logits = jnp.where(valid > 0, mean_sim / temperature, -1e9)
+    conf = jax.nn.softmax(logits)
+    return jnp.where(valid > 0, conf, 0.0)
+
+
 @partial(jax.jit, static_argnames=("k",))
 def top_k_similar(table: jax.Array, queries: jax.Array, k: int):
     """table[T, D], queries[B, D] -> (scores[B, k], indices[B, k]).

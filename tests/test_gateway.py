@@ -430,15 +430,15 @@ def test_streaming_consensus_loop_not_blocked():
     from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
 
     embedder = TpuEmbedder("test-tiny")
-    real_embed = embedder.embed_texts
+    real_update = embedder.stream_vote_update
     embed_threads = []
 
-    def slow_embed(texts, max_tokens=None):
+    def slow_update(*args, **kwargs):
         embed_threads.append(__import__("threading").get_ident())
         _t.sleep(0.15)
-        return real_embed(texts, max_tokens)
+        return real_update(*args, **kwargs)
 
-    embedder.embed_texts = slow_embed
+    embedder.stream_vote_update = slow_update
     scripts = [
         Script([chunk_obj(f"answer {i}", finish="stop")]) for i in range(4)
     ]
@@ -600,10 +600,10 @@ def test_consensus_overlay_degrades_on_embedder_failure():
 
     embedder = TpuEmbedder("test-tiny")
 
-    def boom(texts, max_tokens=None):
+    def boom(*args, **kwargs):
         raise RuntimeError("device OOM")
 
-    embedder.embed_texts = boom
+    embedder.stream_vote_update = boom
     scripts = [
         Script([chunk_obj(f"answer {i}", finish="stop")]) for i in range(3)
     ]

@@ -249,3 +249,106 @@ def test_streaming_self_consistency_incremental():
     )
     assert sc.push_chunk(err) is None
     assert 3 not in sc.confidence and 3 in sc.failed
+
+
+def test_streaming_consensus_grows_past_initial_capacity():
+    """More candidates than the initial device-buffer capacity: the buffer
+    doubles and the final distribution matches the one-shot vote."""
+    import numpy as np
+
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.clients.multichat import (
+        StreamingSelfConsistency,
+    )
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+    from llm_weighted_consensus_tpu.types.multichat_response import (
+        ChatCompletionChunk,
+    )
+
+    def make_chunk(slot, content, finish):
+        return ChatCompletionChunk.from_json_obj(
+            {
+                "id": "mc",
+                "object": "chat.completion.chunk",
+                "created": 1,
+                "model": "m",
+                "choices": [
+                    {
+                        "index": slot,
+                        "delta": {"content": content},
+                        "finish_reason": finish,
+                    }
+                ],
+            }
+        )
+
+    emb = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=32, seed=3)
+    sc = StreamingSelfConsistency(emb)
+    sc.INITIAL_CAPACITY = 4
+    n = 10
+    texts = [f"candidate answer number {i % 3}" for i in range(n)]
+    last = None
+    for i, text in enumerate(texts):
+        out = sc.push_chunk(make_chunk(i, text, finish="stop"))
+        if out is not None:
+            last = out
+    assert last is not None and len(last) == n
+    assert sum(last.values()) == pytest.approx(1.0, abs=1e-4)
+    one_shot = np.asarray(emb.consensus_confidence(texts))
+    np.testing.assert_allclose(
+        [last[i] for i in range(n)], one_shot, atol=1e-4
+    )
+
+
+def test_streaming_consensus_failed_embed_leaves_no_phantom():
+    """A raising embedder must not commit a phantom slot: the candidate
+    retries on the next finish signal and the distribution stays honest."""
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.clients.multichat import (
+        StreamingSelfConsistency,
+    )
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+    from llm_weighted_consensus_tpu.types.multichat_response import (
+        ChatCompletionChunk,
+    )
+
+    def make_chunk(slot, content, finish):
+        return ChatCompletionChunk.from_json_obj(
+            {
+                "id": "mc",
+                "object": "chat.completion.chunk",
+                "created": 1,
+                "model": "m",
+                "choices": [
+                    {
+                        "index": slot,
+                        "delta": {"content": content},
+                        "finish_reason": finish,
+                    }
+                ],
+            }
+        )
+
+    emb = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=32, seed=3)
+    sc = StreamingSelfConsistency(emb)
+    real = emb.stream_vote_update
+    fail = {"next": True}
+
+    def flaky(*args, **kwargs):
+        if fail["next"]:
+            fail["next"] = False
+            raise RuntimeError("transient device OOM")
+        return real(*args, **kwargs)
+
+    emb.stream_vote_update = flaky
+    with pytest.raises(RuntimeError):
+        sc.push_chunk(make_chunk(0, "the answer", finish="stop"))
+    assert sc.count == 0  # no phantom
+    # the same slot retries (finish signal arrives again) and succeeds
+    sc.push_chunk(make_chunk(0, "the answer", finish="stop"))
+    conf = sc.push_chunk(make_chunk(1, "the answer", finish="stop"))
+    assert set(conf) == {0, 1}
+    assert sum(conf.values()) == pytest.approx(1.0, abs=1e-5)
+    assert all(v > 0 for v in conf.values())

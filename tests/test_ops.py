@@ -297,3 +297,33 @@ def test_training_table_weights_batched_matches_loop():
         )
     )
     np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_masked_cosine_vote_matches_subset_vote():
+    """masked vote over a fixed buffer == plain vote over the valid rows
+    (the streaming-consensus invariant)."""
+    rng = np.random.default_rng(5)
+    cap, d = 16, 32
+    for n in (2, 5, 11, 16):
+        x = np.zeros((cap, d), np.float32)
+        x[:n] = rng.normal(size=(n, d))
+        valid = np.zeros((cap,), np.float32)
+        valid[:n] = 1.0
+        got = np.asarray(
+            similarity.masked_cosine_vote(
+                jnp.asarray(x), jnp.asarray(valid), 0.05
+            )
+        )
+        ref = np.asarray(
+            similarity.cosine_consensus_vote(jnp.asarray(x[:n]), 0.05)
+        )
+        np.testing.assert_allclose(got[:n], ref, atol=1e-5)
+        assert np.all(got[n:] == 0.0)
+        # permuted valid positions: same confidences land on the same rows
+        perm = rng.permutation(cap)
+        got_p = np.asarray(
+            similarity.masked_cosine_vote(
+                jnp.asarray(x[perm]), jnp.asarray(valid[perm]), 0.05
+            )
+        )
+        np.testing.assert_allclose(got_p, got[perm], atol=1e-5)

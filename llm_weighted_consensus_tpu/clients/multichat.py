@@ -209,9 +209,12 @@ class StreamingSelfConsistency:
 
     INITIAL_CAPACITY = 16
 
-    def __init__(self, embedder, temperature: float = 0.05):
+    def __init__(self, embedder, temperature: float = 0.05, batcher=None):
         self.embedder = embedder
         self.temperature = temperature
+        # when set (serve/batcher.py), async updates go through the serving
+        # micro-batcher so concurrent streams share device dispatches
+        self.batcher = batcher
         self.texts: dict = {}
         self.failed: set = set()
         self.confidence: dict = {}
@@ -261,32 +264,42 @@ class StreamingSelfConsistency:
             self._buf = jnp.pad(self._buf, ((0, grow), (0, 0)))
             self._valid = jnp.pad(self._valid, (0, grow))
 
-    def _embed_slots(self, slots: list) -> None:
-        """Fold finished candidates into the device buffer; one fused
-        embed+revote dispatch per candidate, one confidence fetch total."""
+    def _next_position(self) -> int:
+        self._ensure_capacity()
+        return len(self._order)
+
+    def _commit(self, slot: int, buf, valid) -> None:
+        # updates are functional (new buffers returned), so nothing commits
+        # until the dispatch succeeds: a raising embedder leaves no phantom
+        # slot behind and the candidate can retry later
+        self._buf, self._valid = buf, valid
+        self._order.append(slot)
+
+    def _publish(self, conf) -> None:
         import numpy as np
 
-        conf = None
-        for slot in slots:
-            self._ensure_capacity()
-            position = len(self._order)
-            # the update is functional (new buffers returned), so nothing
-            # commits until it succeeds: a raising embedder leaves no
-            # phantom slot behind and the candidate can retry later
-            self._buf, self._valid, conf = self.embedder.stream_vote_update(
-                self.texts.get(slot, ""),
-                self._buf,
-                self._valid,
-                position,
-                self.temperature,
-            )
-            self._order.append(slot)
         if conf is not None and self.count >= 2:
             host_conf = np.asarray(conf)  # the ONE fetch
             self.confidence = {
                 slot: float(host_conf[i])
                 for i, slot in enumerate(self._order)
             }
+
+    def _embed_slots(self, slots: list) -> None:
+        """Fold finished candidates into the device buffer; one fused
+        embed+revote dispatch per candidate, one confidence fetch total."""
+        conf = None
+        for slot in slots:
+            position = self._next_position()
+            buf, valid, conf = self.embedder.stream_vote_update(
+                self.texts.get(slot, ""),
+                self._buf,
+                self._valid,
+                position,
+                self.temperature,
+            )
+            self._commit(slot, buf, valid)
+        self._publish(conf)
 
     def push_chunk(self, chunk: ChatCompletionChunk) -> Optional[dict]:
         """Returns {slot: confidence} when the distribution updates.
@@ -301,17 +314,38 @@ class StreamingSelfConsistency:
             return None
         return dict(self.confidence)
 
+    async def _embed_slots_batched(self, slots: list) -> None:
+        """``_embed_slots`` through the serving micro-batcher: each update
+        awaits its turn in a shared device dispatch, so R concurrent
+        streams' finished candidates ride one vmapped embed+revote."""
+        conf = None
+        for slot in slots:
+            position = self._next_position()
+            buf, valid, conf = await self.batcher.stream_update(
+                self.texts.get(slot, ""),
+                self._buf,
+                self._valid,
+                position,
+                self.temperature,
+            )
+            self._commit(slot, buf, valid)
+        self._publish(conf)
+
     async def push_chunk_async(
         self, chunk: ChatCompletionChunk
     ) -> Optional[dict]:
-        """``push_chunk`` with the fused embed+revote dispatch moved to an
-        executor thread (VERDICT r1 item 8: the blocking embed stalled
-        the event loop on every finished candidate)."""
+        """``push_chunk`` with the fused embed+revote dispatch moved off
+        the event loop (VERDICT r1 item 8: the blocking embed stalled the
+        event loop on every finished candidate) — through the micro-batcher
+        when one is attached, else a plain executor hop."""
         pending = self._absorb(chunk)
         if not pending:
             return None
-        loop = asyncio.get_running_loop()
-        await loop.run_in_executor(None, self._embed_slots, pending)
+        if self.batcher is not None:
+            await self._embed_slots_batched(pending)
+        else:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self._embed_slots, pending)
         if self.count < 2:
             return None
         return dict(self.confidence)

@@ -89,6 +89,33 @@ def _stream_vote_update(
     return buf, valid, conf
 
 
+@partial(
+    jax.jit, static_argnames=("config", "pooling")
+)
+def _stream_vote_update_many(
+    params, ids, mask, bufs, valids, positions, config, pooling, temperature
+):
+    """R concurrent streaming-consensus steps in ONE dispatch: embed
+    ids[R, S] as one encoder batch, then vmap the scatter + masked revote
+    over the R per-stream buffers (same capacity bucket).  The serving
+    micro-batcher (serve/batcher.py) groups live streams' updates into
+    this; R=1 callers use ``_stream_vote_update``.  Rows past R (batch
+    bucketing) are sliced off before the vmap."""
+    from ..ops.similarity import masked_cosine_vote
+
+    r = bufs.shape[0]
+    vecs = bert.embed(params, ids, mask, config, pooling=pooling)[:r]
+
+    def update(buf, valid, vec, position):
+        buf = buf.at[position].set(vec.astype(buf.dtype))
+        valid = valid.at[position].set(1.0)
+        with jax.named_scope("stream_masked_vote"):
+            conf = masked_cosine_vote(buf, valid, temperature)
+        return buf, valid, conf
+
+    return jax.vmap(update)(bufs, valids, vecs, positions)
+
+
 def _bucket(n: int, cap: int) -> int:
     """Next power of two >= n (min 16), capped."""
     size = 16
@@ -280,16 +307,54 @@ class TpuEmbedder:
             temperature,
         )
 
+    def stream_vote_update_many(
+        self,
+        texts: list,
+        bufs: list,
+        valids: list,
+        positions: list,
+        temperature: float = 0.05,
+    ):
+        """R streaming-consensus steps (one per live stream, same capacity
+        bucket) in ONE dispatch -> (bufs[R, CAP, H], valids[R, CAP],
+        confidences[R, CAP]).  The batch dim is bucketed so the jit
+        specializes per (R-bucket, CAP, S-bucket), not per exact R; pad
+        rows attend to one [PAD] token and their outputs are sliced off."""
+        r = len(texts)
+        ids, mask = self.tokenize(texts)
+        pad = _bucket(r, self.MAX_DEVICE_BATCH) - r
+        dev_bufs = jnp.stack(bufs)
+        dev_valids = jnp.stack(valids)
+        pos = np.zeros((r + pad,), dtype=np.int32)
+        pos[:r] = positions
+        if pad:
+            ids = np.pad(ids, ((0, pad), (0, 0)))
+            mask = np.pad(mask, ((0, pad), (0, 0)))
+            mask[-pad:, 0] = 1
+            dev_bufs = jnp.pad(dev_bufs, ((0, pad), (0, 0), (0, 0)))
+            dev_valids = jnp.pad(dev_valids, ((0, pad), (0, 0)))
+        out_bufs, out_valids, confs = _stream_vote_update_many(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(mask),
+            dev_bufs,
+            dev_valids,
+            jnp.asarray(pos),
+            self.config,
+            self.pooling,
+            temperature,
+        )
+        return out_bufs[:r], out_valids[:r], confs[:r]
+
     # -- wire contract --------------------------------------------------------
 
-    def embeddings_response(
-        self, texts: list, max_tokens: Optional[int] = None
+    def wire_response(
+        self, emb: np.ndarray, tokens: int
     ) -> CreateEmbeddingResponse:
-        """The OpenAI embeddings response (types/embeddings.py), with usage
-        = real token counts for cost accounting.  Tokenizes once."""
-        ids, mask = self.tokenize(texts, max_tokens)
-        emb = self.embed_tokens(ids, mask)
-        tokens = int(mask.sum())
+        """Wrap already-computed embeddings as the OpenAI response
+        (types/embeddings.py) with usage = real token counts for cost
+        accounting — the assembly half of ``embeddings_response``, split
+        out so batched callers (serve/batcher.py) can reuse it."""
         return CreateEmbeddingResponse(
             object="list",
             data=[
@@ -305,3 +370,11 @@ class TpuEmbedder:
                 prompt_tokens=tokens, completion_tokens=0, total_tokens=tokens
             ),
         )
+
+    def embeddings_response(
+        self, texts: list, max_tokens: Optional[int] = None
+    ) -> CreateEmbeddingResponse:
+        """The OpenAI embeddings response.  Tokenizes once."""
+        ids, mask = self.tokenize(texts, max_tokens)
+        emb = self.embed_tokens(ids, mask)
+        return self.wire_response(emb, int(mask.sum()))

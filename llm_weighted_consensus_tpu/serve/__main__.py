@@ -358,6 +358,19 @@ def build_service(config: Config, fake_upstream: bool = False):
     )
     model_registry = registry.InMemoryModelRegistry()
     embedder = build_embedder(config)
+    batcher = None
+    metrics = None
+    if embedder is not None:
+        from .batcher import DeviceBatcher
+        from .metrics import Metrics
+
+        metrics = Metrics()
+        batcher = DeviceBatcher(
+            embedder,
+            metrics,
+            window_ms=config.batch_window_ms,
+            max_batch=config.batch_max,
+        )
     weight_fetchers = WeightFetchers()
     tables = None
     if embedder is not None:
@@ -375,7 +388,9 @@ def build_service(config: Config, fake_upstream: bool = False):
 
             probe_writable(config.tables_path)
         weight_fetchers = WeightFetchers(
-            training_table_fetcher=TpuTrainingTableFetcher(embedder, tables)
+            training_table_fetcher=TpuTrainingTableFetcher(
+                embedder, tables, batcher=batcher
+            )
         )
     score_client = ScoreClient(
         chat_client,
@@ -408,7 +423,9 @@ def build_service(config: Config, fake_upstream: bool = False):
         gw_score,
         gw_multichat,
         embedder,
+        metrics=metrics,
         profile_dir=config.profile_dir,
+        batcher=batcher,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables

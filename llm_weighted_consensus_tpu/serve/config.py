@@ -51,6 +51,13 @@ TPU additions:
   at startup when present, saved on graceful shutdown.  With an embedder
   configured, ``POST /weights/learn`` builds rows from the archive into
   the live tables (weights/learning.py).
+* ``BATCH_WINDOW_MS`` — the micro-batching accumulation window
+  (serve/batcher.py): concurrent requests' device work arriving within
+  this window (or behind an in-flight dispatch) is fused into one batched
+  device call.  ``0`` disables the idle wait but still batches behind
+  in-flight dispatches.  Default 3.
+* ``BATCH_MAX`` — max items per fused device dispatch (oversized groups
+  chunk).  Default 64.
 """
 
 from __future__ import annotations
@@ -115,6 +122,8 @@ class Config:
     archive_path: Optional[str] = None
     archive_write: bool = False
     tables_path: Optional[str] = None
+    batch_window_ms: float = 3.0
+    batch_max: int = 64
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -179,6 +188,8 @@ class Config:
                 in ("1", "true", "yes", "on")
             ),
             tables_path=env.get("TABLES_PATH"),
+            batch_window_ms=get_f("BATCH_WINDOW_MS", 3.0),
+            batch_max=int(env.get("BATCH_MAX", 64)),
         )
 
     def backoff_policy(self):

@@ -24,6 +24,8 @@ from ..types.score_request import ChatCompletionCreateParams as ScoreParams
 from ..utils import jsonutil
 
 METRICS_KEY: web.AppKey = web.AppKey("metrics", Metrics)
+# the serving micro-batcher (present when an embedder is configured)
+BATCHER_KEY: web.AppKey = web.AppKey("batcher", object)
 
 DONE = b"data: [DONE]\n\n"
 SSE_HEADERS = {
@@ -99,12 +101,13 @@ def _make_handler(params_cls, create_streaming, create_unary):
     return handler
 
 
-async def _with_consensus_frames(stream, embedder, metrics=None):
+async def _with_consensus_frames(stream, embedder, metrics=None, batcher=None):
     """Interleave live ``multichat.consensus`` frames into a multichat
-    stream; embeds + revotes run on an executor thread (never the loop)."""
+    stream; embeds + revotes run off the loop — through the micro-batcher
+    (shared dispatches across concurrent streams) when one is attached."""
     from ..clients.multichat import ConsensusUpdate, StreamingSelfConsistency
 
-    sc = StreamingSelfConsistency(embedder)
+    sc = StreamingSelfConsistency(embedder, batcher=batcher)
     try:
         async for chunk in stream:
             yield chunk
@@ -140,14 +143,49 @@ async def _with_consensus_frames(stream, embedder, metrics=None):
             await aclose()
 
 
-def _multichat_streaming(multichat_client, embedder, metrics):
+def _multichat_streaming(multichat_client, embedder, metrics, batcher=None):
     async def create_streaming(ctx, params):
         stream = await multichat_client.create_streaming(ctx, params)
         if params.consensus and embedder is not None:
-            return _with_consensus_frames(stream, embedder, metrics)
+            return _with_consensus_frames(stream, embedder, metrics, batcher)
         return stream
 
     return create_streaming
+
+
+def _multichat_unary(multichat_client, embedder, batcher):
+    """Unary multichat with ``consensus: true``: after the fold, embed all
+    finished candidates + consensus-vote in ONE fused dispatch and attach
+    the confidence distribution (the unary view of the streaming
+    ``multichat.consensus`` frames).  The batcher coalesces concurrent
+    requests with the same candidate count into one device batch
+    (``consensus_confidence_tokens_many``)."""
+
+    async def create_unary(ctx, params):
+        result = await multichat_client.create_unary(ctx, params)
+        if not (params.consensus and embedder is not None and batcher):
+            return result
+        slots, texts = [], []
+        for choice in result.choices:
+            content = getattr(choice.message, "content", None)
+            if choice.error is None and isinstance(content, str) and content:
+                slots.append(choice.index)
+                texts.append(content)
+        if len(texts) >= 2:
+            try:
+                conf = await batcher.consensus(texts)
+            except Exception:
+                # the consensus is an overlay on the multichat result: an
+                # embedder failure degrades to plain multichat (no
+                # `consensus` field) rather than discarding N completed
+                # generations with a 5xx — mirrors the streaming path
+                return result
+            result.consensus = {
+                str(slot): float(c) for slot, c in zip(slots, conf)
+            }
+        return result
+
+    return create_unary
 
 
 def _profile_handlers(profile_dir: str):
@@ -212,10 +250,29 @@ def build_app(
     embedder=None,
     metrics=None,
     profile_dir=None,
+    batcher=None,
+    batch_window_ms: float = 3.0,
+    batch_max: int = 64,
 ) -> web.Application:
     metrics = metrics or Metrics()
+    if embedder is not None and batcher is None:
+        from .batcher import DeviceBatcher
+
+        batcher = DeviceBatcher(
+            embedder,
+            metrics,
+            window_ms=batch_window_ms,
+            max_batch=batch_max,
+        )
     app = web.Application(middlewares=[middleware(metrics)])
     app[METRICS_KEY] = metrics
+    if batcher is not None:
+        app[BATCHER_KEY] = batcher
+
+        async def _close_batcher(app):
+            batcher.close()
+
+        app.on_cleanup.append(_close_batcher)
     app.router.add_post(
         "/chat/completions",
         _make_handler(
@@ -237,13 +294,15 @@ def build_app(
             "/multichat/completions",
             _make_handler(
                 MultichatParams,
-                _multichat_streaming(multichat_client, embedder, metrics),
-                multichat_client.create_unary,
+                _multichat_streaming(
+                    multichat_client, embedder, metrics, batcher
+                ),
+                _multichat_unary(multichat_client, embedder, batcher),
             ),
         )
     if embedder is not None:
         app.router.add_post(
-            "/embeddings", _embeddings_handler(embedder, metrics)
+            "/embeddings", _embeddings_handler(embedder, metrics, batcher)
         )
 
     async def healthz(request):
@@ -261,7 +320,7 @@ def build_app(
     return app
 
 
-def _embeddings_handler(embedder, metrics=None):
+def _embeddings_handler(embedder, metrics=None, batcher=None):
     async def handler(request: web.Request):
         try:
             params = CreateEmbeddingParams.from_json_obj(
@@ -291,15 +350,25 @@ def _embeddings_handler(embedder, metrics=None):
         import asyncio
 
         try:
-            # the device forward blocks; keep the event loop responsive
-            t0 = _time.perf_counter()
-            resp = await asyncio.get_running_loop().run_in_executor(
-                None, embedder.embeddings_response, params.inputs()
-            )
-            if metrics is not None:
-                metrics.observe(
-                    "device:embed", (_time.perf_counter() - t0) * 1e3
+            if batcher is not None:
+                # the micro-batcher coalesces concurrent requests' texts
+                # into one tokenize + one embed_tokens dispatch; response
+                # assembly (per-row tolist over possibly thousands of
+                # vectors) still stays off the event loop
+                emb, tokens = await batcher.embed(params.inputs())
+                resp = await asyncio.get_running_loop().run_in_executor(
+                    None, embedder.wire_response, emb, tokens
                 )
+            else:
+                # the device forward blocks; keep the event loop responsive
+                t0 = _time.perf_counter()
+                resp = await asyncio.get_running_loop().run_in_executor(
+                    None, embedder.embeddings_response, params.inputs()
+                )
+                if metrics is not None:
+                    metrics.observe(
+                        "device:embed", (_time.perf_counter() - t0) * 1e3
+                    )
         except Exception as e:
             return _error_response(e)
         return web.Response(

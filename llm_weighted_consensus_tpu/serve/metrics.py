@@ -21,6 +21,7 @@ class Metrics:
         self._counts: dict = {}
         self._errors: dict = {}
         self._latencies: dict = {}
+        self._providers: dict = {}
         self._started = time.time()
 
     def observe(self, series: str, ms: float, *, error: bool = False) -> None:
@@ -28,6 +29,11 @@ class Metrics:
         if error:
             self._errors[series] = self._errors.get(series, 0) + 1
         self._latencies.setdefault(series, deque(maxlen=_RESERVOIR)).append(ms)
+
+    def register_provider(self, name: str, fn) -> None:
+        """Attach a live gauge section to the snapshot (e.g. the device
+        batcher's queue depth / busy fraction — SURVEY §5 "device util")."""
+        self._providers[name] = fn
 
     def snapshot(self) -> dict:
         out = {}
@@ -40,7 +46,16 @@ class Metrics:
                     lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2
                 )
             out[series] = entry
-        return {"uptime_sec": round(time.time() - self._started, 1), "series": out}
+        snap = {
+            "uptime_sec": round(time.time() - self._started, 1),
+            "series": out,
+        }
+        for name, fn in self._providers.items():
+            try:
+                snap[name] = fn()
+            except Exception as e:  # a broken gauge must not break /metrics
+                snap[name] = {"error": str(e)}
+        return snap
 
 
 def _series(request) -> str:

@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Optional
 
 from .base import ResponseError
-from .base import Const, KEEP, KEYED, List, NESTED, Struct, field
+from .base import Const, KEEP, KEYED, List, Map, NESTED, Struct, field
 from .chat_response import (
     Delta,
     FINISH_REASON,
@@ -99,6 +99,11 @@ class ChatCompletion(Struct):
     model: str = field(str, default="", skip_if_none=False)
     object: str = field(Const("chat.completion"), default="chat.completion")
     usage: Optional[Usage] = field(Usage, default=None)
+    # wire extension (no reference analog — the reference has no multichat
+    # client): the unary view of the streaming ``multichat.consensus``
+    # frames, {slot: confidence} over finished candidates, present when the
+    # request set ``consensus: true`` and the gateway has an embedder
+    consensus: Optional[dict] = field(Map(float), default=None)
 
     @classmethod
     def from_streaming(cls, chunk: ChatCompletionChunk) -> "ChatCompletion":

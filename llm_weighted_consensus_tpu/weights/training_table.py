@@ -93,32 +93,55 @@ class TrainingTableStore:
 
 
 class TpuTrainingTableFetcher(TrainingTableWeightFetcher):
-    def __init__(self, embedder, store: Optional[TrainingTableStore] = None):
+    def __init__(
+        self,
+        embedder,
+        store: Optional[TrainingTableStore] = None,
+        batcher=None,
+    ):
         self.embedder = embedder
         # NOT `store or ...`: an EMPTY shared store is falsy (__len__ == 0)
         # and would be silently replaced, detaching the fetcher from the
         # store that learning later populates
         self.store = store if store is not None else TrainingTableStore()
+        # when set (serve/batcher.py), the prompt embedding — the heavy
+        # dispatch — rides the serving micro-batcher, coalescing with other
+        # concurrent requests' device work
+        self.batcher = batcher
 
     async def fetch(self, ctx, request, model):
         import asyncio
 
-        # device work off the event loop thread
-        return await asyncio.get_running_loop().run_in_executor(
-            None, self._fetch_sync, request, model
+        loop = asyncio.get_running_loop()
+        if self.batcher is None:
+            # device work off the event loop thread
+            return await loop.run_in_executor(
+                None, self._fetch_sync, request, model
+            )
+        cfg = model.weight  # PanelWeightTrainingTable
+        max_tokens = getattr(cfg.embeddings, "max_tokens", None)
+        emb, tokens = await self.batcher.embed(
+            [request.template_content()], max_tokens=max_tokens
         )
+        response = self.embedder.wire_response(emb, tokens)
+        # the per-judge table lookup is a small dispatch; plain executor hop
+        return await loop.run_in_executor(None, self._lookup, response, model)
 
     def _fetch_sync(self, request, model):
-        import jax.numpy as jnp
-
-        from ..ops.similarity import training_table_weights_batched
-
         cfg = model.weight  # PanelWeightTrainingTable
         max_tokens = getattr(cfg.embeddings, "max_tokens", None)
         text = request.template_content()
         response = self.embedder.embeddings_response(
             [text], max_tokens=max_tokens
         )
+        return self._lookup(response, model)
+
+    def _lookup(self, response, model):
+        import jax.numpy as jnp
+
+        from ..ops.similarity import training_table_weights_batched
+
+        cfg = model.weight  # PanelWeightTrainingTable
         query = np.asarray(response.data[0].embedding, dtype=np.float32)
         top = int(cfg.top)
 

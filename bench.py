@@ -96,6 +96,35 @@ def bench_tokenizer():
     return WordPieceTokenizer(vocab)
 
 
+def bench_spm_tokenizer(vocab_size: int):
+    """A real unigram SentencePiece tokenizer (models/spm.py Viterbi path)
+    over a deterministic vocab covering the bench word list, deberta id
+    scheme — so config 3 times the deployment-shaped host tokenization
+    instead of the hash stand-in.  Scores prefer whole-word pieces over
+    char decomposition (word length-weighted), as a trained unigram LM
+    would."""
+    from llm_weighted_consensus_tpu.models.spm import (
+        CONTROL,
+        NORMAL,
+        SPACE,
+        UNKNOWN,
+        UnigramTokenizer,
+    )
+
+    pieces = [
+        ("[PAD]", 0.0, CONTROL),
+        ("[CLS]", 0.0, CONTROL),
+        ("[SEP]", 0.0, CONTROL),
+        ("[UNK]", 0.0, UNKNOWN),
+    ]
+    for word in BENCH_WORDS:
+        pieces.append((SPACE + word, -float(len(word)), NORMAL))
+    for ch in "abcdefghijklmnopqrstuvwxyz0123456789" + SPACE:
+        pieces.append((ch, -10.0, NORMAL))
+    assert len(pieces) <= vocab_size, "deberta vocab must cover pieces"
+    return UnigramTokenizer(pieces, scheme="deberta")
+
+
 def tokenize_fixed(embedder, texts: list, seq: int):
     """Tokenize to the exact benchmark shape [N, seq] (no bucket shrink —
     the metric is defined at seq=128)."""

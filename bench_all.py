@@ -208,14 +208,18 @@ def bench_rm_reranking(n: int, seq: int, requests: int) -> None:
     import jax.numpy as jnp
     from functools import partial
 
+    from bench import bench_spm_tokenizer
+
     from llm_weighted_consensus_tpu.models import deberta
     from llm_weighted_consensus_tpu.models.configs import DEBERTA_V3_BASE
-    from llm_weighted_consensus_tpu.models.tokenizer import HashTokenizer
 
     config = DEBERTA_V3_BASE
     dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    # random-init RM weights (no deberta checkpoint in this image) but the
+    # REAL host path: unigram spm tokenization via models/spm.py — real
+    # checkpoints load with load_params + the spm.model beside them
     params = deberta.init_params(jax.random.PRNGKey(0), config, dtype=dtype)
-    tok = HashTokenizer(config.vocab_size)
+    tok = bench_spm_tokenizer(config.vocab_size)
     reqs = make_requests(requests, n)
 
     @partial(jax.jit, static_argnames=())
@@ -248,6 +252,10 @@ def bench_rm_reranking(n: int, seq: int, requests: int) -> None:
         "answers/sec",
         p50_ms=round(statistics.median(lat), 2),
         requests=len(reqs),
+        numerics=(
+            "random-init RM weights (no checkpoint in image); real unigram "
+            "spm tokenization on the host path (models/spm.py)"
+        ),
     )
 
 

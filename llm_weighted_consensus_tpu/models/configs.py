@@ -79,9 +79,9 @@ GTE_LARGE = BertConfig(
 # BGE-M3 (BAAI/bge-m3 dense retrieval: XLM-RoBERTa-large arch, 8192-token
 # context, CLS pooling).  Positions are roberta-style; the 8194-row table
 # minus pad_token_id+1 gives the advertised 8192-token window.  Serve long
-# inputs with MESH_SP (ring attention).  The real checkpoint's
-# sentencepiece tokenizer is out of scope offline — configure
-# EMBEDDER_VOCAB for WordPiece or accept the hash fallback for shape work.
+# inputs with MESH_SP (ring attention).  The checkpoint's
+# sentencepiece.bpe.model tokenizes via models/spm.py (xlmr id scheme,
+# auto-discovered next to EMBEDDER_WEIGHTS or set EMBEDDER_VOCAB).
 BGE_M3 = BertConfig(
     vocab_size=250002,
     hidden_size=1024,

@@ -93,11 +93,18 @@ def load_params(path: str, config: BertConfig, dtype=None) -> dict:
 
 
 def find_vocab(weights_path: str) -> Optional[str]:
-    """vocab.txt sitting next to the weights, if any (HF snapshot layout)."""
+    """Tokenizer asset sitting next to the weights, if any (HF snapshot
+    layout): WordPiece ``vocab.txt`` or a SentencePiece model proto
+    (XLM-R/bge-m3 ship ``sentencepiece.bpe.model``, DeBERTa ``spm.model``)."""
+    from .spm import SPM_FILES
+
     root = (
         weights_path
         if os.path.isdir(weights_path)
         else os.path.dirname(weights_path)
     )
-    candidate = os.path.join(root, "vocab.txt")
-    return candidate if os.path.exists(candidate) else None
+    for name in ("vocab.txt",) + SPM_FILES:
+        candidate = os.path.join(root, name)
+        if os.path.exists(candidate):
+            return candidate
+    return None

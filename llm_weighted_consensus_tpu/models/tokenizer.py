@@ -266,12 +266,25 @@ class HashTokenizer(BaseTokenizer):
 
 
 def load_tokenizer(
-    vocab_path: Optional[str] = None, vocab_size: int = 30522
+    vocab_path: Optional[str] = None,
+    vocab_size: int = 30522,
+    scheme: Optional[str] = None,
 ) -> BaseTokenizer:
-    """WordPiece when a local vocab exists, hash fallback otherwise."""
+    """Real tokenizer when a local vocab exists, hash fallback otherwise.
+
+    ``*.txt`` -> WordPiece; ``*.model`` / ``*.spm`` -> SentencePiece
+    unigram (``scheme`` picks the xlmr/deberta id convention, default
+    xlmr — see models/spm.py).
+    """
     if vocab_path:
         import os
 
         if os.path.exists(vocab_path):
+            if vocab_path.endswith((".model", ".spm")):
+                from .spm import UnigramTokenizer
+
+                return UnigramTokenizer.from_model_file(
+                    vocab_path, scheme or "xlmr"
+                )
             return WordPieceTokenizer.from_vocab_file(vocab_path)
     return HashTokenizer(vocab_size)

@@ -229,6 +229,7 @@ def build_embedder(config: Config):
         return None
     from ..models.configs import PRESETS
     from ..models.embedder import TpuEmbedder
+    from ..models.spm import scheme_for_model
     from ..models.tokenizer import load_tokenizer
 
     params = None
@@ -256,8 +257,16 @@ def build_embedder(config: Config):
         config.embedder_model,
         params=params,
         # only override the tokenizer when a real vocab is available;
-        # TpuEmbedder's default hash fallback sizes to the model vocab
-        tokenizer=load_tokenizer(vocab_path) if vocab_path else None,
+        # TpuEmbedder's default hash fallback sizes to the model vocab.
+        # scheme matters only for spm protos (bge-m3 -> xlmr convention)
+        tokenizer=(
+            load_tokenizer(
+                vocab_path,
+                scheme=scheme_for_model(config.embedder_model),
+            )
+            if vocab_path
+            else None
+        ),
         max_tokens=max_tokens,
     )
     if config.mesh_sp is not None:

@@ -1,0 +1,321 @@
+"""SentencePiece unigram tokenizer (models/spm.py).
+
+Parity target: HF ``tokenizers``' ``Unigram`` model — the exact engine
+``XLMRobertaTokenizerFast`` runs — configured with the same metaspace
+pre-tokenization this module implements.  Shared vocabularies are built in
+the tests, segmented by both implementations, and compared piece-for-piece.
+The ModelProto parser is pinned by serializing protos with a local
+wire-format writer and round-tripping.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from llm_weighted_consensus_tpu.models.spm import (
+    BYTE,
+    CONTROL,
+    NORMAL,
+    UNKNOWN,
+    USER_DEFINED,
+    SPACE,
+    UnigramTokenizer,
+    normalize,
+    parse_model_proto,
+    scheme_for_model,
+)
+
+
+# -- proto writer (test-local; mirrors sentencepiece_model.proto wire fmt) --
+
+
+def _varint(value: int) -> bytes:
+    out = b""
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out += bytes([byte | 0x80])
+        else:
+            return out + bytes([byte])
+
+
+def _piece_msg(piece: str, score: float, ptype: int) -> bytes:
+    raw = piece.encode("utf-8")
+    body = b"\x0a" + _varint(len(raw)) + raw  # field 1, wire 2
+    body += b"\x15" + struct.pack("<f", score)  # field 2, wire 5
+    if ptype != NORMAL:
+        body += b"\x18" + _varint(ptype)  # field 3, wire 0
+    return body
+
+
+def serialize_proto(pieces, trailer: bytes = b"") -> bytes:
+    out = b""
+    for piece, score, ptype in pieces:
+        msg = _piece_msg(piece, score, ptype)
+        out += b"\x0a" + _varint(len(msg)) + msg  # ModelProto field 1
+    return out + trailer
+
+
+# an XLM-R-shaped vocab: unk/bos/eos first, then scored pieces
+# (scores pass through f32 in the proto, so pin them to f32 values)
+_RAW_PIECES = [
+    ("<unk>", 0.0, UNKNOWN),
+    ("<s>", 0.0, CONTROL),
+    ("</s>", 0.0, CONTROL),
+    (SPACE, -2.0, NORMAL),
+    ("a", -3.0, NORMAL),
+    ("b", -3.5, NORMAL),
+    ("c", -4.0, NORMAL),
+    ("ab", -4.5, NORMAL),
+    ("bc", -5.0, NORMAL),
+    ("abc", -5.5, NORMAL),
+    (SPACE + "ab", -3.2, NORMAL),
+    (SPACE + "hello", -6.0, NORMAL),
+    ("hello", -7.0, NORMAL),
+    ("world", -7.5, NORMAL),
+    ("wor", -5.0, NORMAL),
+    ("ld", -4.0, NORMAL),
+    (SPACE + "the", -2.5, NORMAL),
+    ("ing", -3.8, NORMAL),
+    ("token", -6.5, NORMAL),
+    (SPACE + "token", -6.2, NORMAL),
+    ("iz", -4.2, NORMAL),
+    ("er", -3.3, NORMAL),
+    ("s", -2.9, NORMAL),
+]
+XLMR_PIECES = [
+    (p, float(np.float32(s)), t) for p, s, t in _RAW_PIECES
+]
+
+
+def test_proto_roundtrip():
+    data = serialize_proto(XLMR_PIECES)
+    assert parse_model_proto(data) == XLMR_PIECES
+
+
+def test_proto_skips_unknown_fields():
+    # trainer_spec (field 2) and normalizer_spec (field 3) are skipped;
+    # unknown scalar fields inside a piece are skipped too
+    trainer = b"\x12" + _varint(3) + b"abc"
+    normalizer = b"\x1a" + _varint(2) + b"xy"
+    data = serialize_proto(XLMR_PIECES[:5], trailer=trainer + normalizer)
+    # reorder so the trailer is interleaved: parser must not care
+    data = trainer + data
+    assert parse_model_proto(data) == XLMR_PIECES[:5]
+
+
+def test_proto_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_model_proto(b"\x12\x00")  # valid wire, zero pieces
+
+
+def test_proto_rejects_truncation():
+    data = serialize_proto(XLMR_PIECES)
+    # a partial download must fail loudly, not yield a shorter vocab
+    for cut in (len(data) - 1, len(data) // 2, 3):
+        with pytest.raises(ValueError):
+            parse_model_proto(data[:cut])
+
+
+def _hf_unigram(pieces):
+    """tokenizers' Unigram configured the way transformers' SpmConverter
+    builds XLMRobertaTokenizerFast: whitespace collapse (the converter's
+    ``Replace(" {2,}", " ")``, mirroring spm remove_extra_whitespaces)
+    then Metaspace pre-tokenization, then the Unigram model."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Regex, Tokenizer, models, normalizers, pre_tokenizers
+
+    vocab = [(p, s) for p, s, _ in pieces]
+    unk_id = next(i for i, (_, _, t) in enumerate(pieces) if t == UNKNOWN)
+    tok = Tokenizer(models.Unigram(vocab, unk_id=unk_id, byte_fallback=False))
+    tok.normalizer = normalizers.Sequence(
+        [
+            normalizers.Replace(Regex(" {2,}"), " "),
+            normalizers.Strip(),
+        ]
+    )
+    tok.pre_tokenizer = pre_tokenizers.Metaspace(
+        replacement=SPACE, prepend_scheme="always"
+    )
+    return tok
+
+
+PARITY_TEXTS = [
+    "hello world",
+    "ab abc bca cab",
+    "the tokenizers tokenize tokens",
+    "a",
+    "abcabcabc",
+    "hello helloworld worlds",
+    "the the the ab ab",
+    "zzz unknown zz chars",  # unknown chars force unk fusion
+    "mixed abz zab zzab",
+    "s s s ing ing",
+]
+
+
+@pytest.mark.parametrize("text", PARITY_TEXTS)
+def test_viterbi_parity_with_hf_tokenizers(text):
+    ours = UnigramTokenizer(XLMR_PIECES, scheme="xlmr")
+    theirs = _hf_unigram(XLMR_PIECES)
+    assert ours.tokenize_text(text) == theirs.encode(text).tokens
+
+
+def test_viterbi_parity_randomized_vocab():
+    # a larger randomized vocab: substrings of a small alphabet with
+    # seeded random scores — stresses tie-free max-sum path selection
+    rng = np.random.default_rng(7)
+    alphabet = "abcde"
+    pieces = [("<unk>", 0.0, UNKNOWN)]
+    seen = {"<unk>"}
+    for length in (1, 2, 3):
+        for _ in range(40):
+            piece = "".join(rng.choice(list(alphabet), size=length))
+            if piece not in seen:
+                seen.add(piece)
+                pieces.append(
+                    (piece, float(-rng.uniform(1, 12)), NORMAL)
+                )
+    # every single char must be in-vocab plus the metaspace prefix forms
+    for ch in alphabet + SPACE:
+        if ch not in seen:
+            pieces.append((ch, float(-rng.uniform(1, 12)), NORMAL))
+    ours = UnigramTokenizer(pieces, scheme="xlmr")
+    theirs = _hf_unigram(pieces)
+    texts = [
+        "".join(rng.choice(list(alphabet + "  "), size=30)).strip() or "a"
+        for _ in range(25)
+    ]
+    for text in texts:
+        assert (
+            ours.tokenize_text(text) == theirs.encode(text).tokens
+        ), text
+
+
+def test_xlmr_id_scheme():
+    tok = UnigramTokenizer(XLMR_PIECES, scheme="xlmr")
+    # fairseq specials
+    assert (tok.cls_id, tok.pad_id, tok.sep_id, tok.unk_id) == (0, 1, 2, 3)
+    # piece ids shift by +1:  ▁hello is spm id 11 -> 12
+    ids, mask = tok.encode_batch(["hello"], max_length=8)
+    assert ids[0, 0] == 0 and ids[0, 2] == 2  # <s> ... </s>
+    assert ids[0, 1] == 11 + 1
+    assert mask[0].sum() == 3
+    # vocab_size covers <mask> at the end
+    assert tok.vocab_size == len(XLMR_PIECES) + 2
+
+
+def test_deberta_id_scheme():
+    pieces = [
+        ("[PAD]", 0.0, CONTROL),
+        ("[CLS]", 0.0, CONTROL),
+        ("[SEP]", 0.0, CONTROL),
+        ("[UNK]", 0.0, UNKNOWN),
+        (SPACE + "ab", -2.0, NORMAL),
+        ("ab", -3.0, NORMAL),
+        ("a", -4.0, NORMAL),
+        ("b", -4.5, NORMAL),
+        (SPACE, -1.5, NORMAL),
+    ]
+    tok = UnigramTokenizer(pieces, scheme="deberta")
+    assert (tok.pad_id, tok.cls_id, tok.sep_id, tok.unk_id) == (0, 1, 2, 3)
+    ids, mask = tok.encode_batch(["ab"], max_length=8)
+    # [CLS] ▁ab [SEP] with DIRECT spm ids (no fairseq offset)
+    assert list(ids[0, :3]) == [1, 4, 2]
+    assert tok.vocab_size == len(pieces)
+
+
+def test_unknown_chars_map_to_unk_and_fuse():
+    tok = UnigramTokenizer(XLMR_PIECES, scheme="xlmr")
+    # unknown runs fuse into ONE raw-text token (id = unk)
+    pieces = tok.tokenize_text("ab zzz ab")
+    assert pieces == [SPACE + "ab", SPACE, "zzz", SPACE + "ab"]
+    ids, mask = tok.encode_batch(["zzz"], max_length=8)
+    assert tok.unk_id in ids[0]
+    assert mask[0].sum() == 4  # <s> ▁ zzz </s>
+
+
+def test_truncation_and_padding_shapes():
+    tok = UnigramTokenizer(XLMR_PIECES, scheme="xlmr")
+    ids, mask = tok.encode_batch(
+        ["hello world " * 50, "ab"], max_length=16
+    )
+    assert ids.shape == (2, 16) and mask.shape == (2, 16)
+    assert mask[0].sum() == 16  # truncated to cap
+    assert ids[0, -1] == tok.sep_id  # sep survives truncation
+    assert ids[1, mask[1].sum() - 1] == tok.sep_id
+    assert (ids[1][mask[1] == 0] == tok.pad_id).all()
+
+
+def test_normalize_nfkc_and_controls():
+    assert normalize("ｈｅｌｌｏ") == "hello"  # NFKC fullwidth fold
+    assert normalize("a\x00b\tc") == "ab c"  # controls dropped, tab->space
+    assert "①" not in normalize("①")  # circled digits fold
+
+
+def test_user_defined_pieces_match():
+    pieces = XLMR_PIECES + [("<special>", 0.0, USER_DEFINED)]
+    tok = UnigramTokenizer(pieces, scheme="xlmr")
+    out = tok.tokenize_text("ab <special>")
+    # USER_DEFINED participates in segmentation like a normal piece...
+    assert "<special>" in "".join(out)
+    # ...while CONTROL pieces never match text
+    assert "<s>" not in tok.tokenize_text("ab <s> ab")
+
+
+def test_control_and_byte_pieces_excluded_from_matching():
+    # a BYTE piece must not match its own literal name in text: the run
+    # falls through to unknown (raw text token, id = unk)
+    pieces = XLMR_PIECES + [("<0x41>", -1.0, BYTE)]
+    tok = UnigramTokenizer(pieces, scheme="xlmr")
+    ids, _ = tok.encode_batch(["<0x41>"], max_length=8)
+    byte_id = len(pieces) - 1 + 1  # spm id + xlmr offset
+    assert byte_id not in ids[0]
+    assert tok.unk_id in ids[0]
+
+
+def test_model_file_loading(tmp_path):
+    path = tmp_path / "sentencepiece.bpe.model"
+    path.write_bytes(serialize_proto(XLMR_PIECES))
+    tok = UnigramTokenizer.from_model_file(str(path), scheme="xlmr")
+    assert tok.tokenize_text("hello") == [SPACE + "hello"]
+
+    from llm_weighted_consensus_tpu.models.tokenizer import load_tokenizer
+
+    loaded = load_tokenizer(str(path))
+    assert isinstance(loaded, UnigramTokenizer)
+    assert loaded.tokenize_text("hello") == [SPACE + "hello"]
+
+
+def test_find_vocab_discovers_spm(tmp_path):
+    from llm_weighted_consensus_tpu.models.loading import find_vocab
+
+    (tmp_path / "model.safetensors").write_bytes(b"")
+    spm = tmp_path / "sentencepiece.bpe.model"
+    spm.write_bytes(serialize_proto(XLMR_PIECES))
+    assert find_vocab(str(tmp_path)) == str(spm)
+    # vocab.txt wins when both exist (WordPiece checkpoints)
+    vocab = tmp_path / "vocab.txt"
+    vocab.write_text("[PAD]\n[UNK]\n[CLS]\n[SEP]\na\n")
+    assert find_vocab(str(tmp_path)) == str(vocab)
+
+
+def test_scheme_for_model():
+    assert scheme_for_model("bge-m3") == "xlmr"
+    assert scheme_for_model("deberta-v3-base") == "deberta"
+
+
+def test_embedder_integration_bge_m3_shapes(tmp_path):
+    """bge-m3 preset + spm tokenizer end-to-end through TpuEmbedder
+    (tiny config stands in for the 24-layer real shape)."""
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    tok = UnigramTokenizer(XLMR_PIECES, scheme="xlmr")
+    emb = TpuEmbedder("test-tiny", tokenizer=tok, max_tokens=32)
+    out = emb.embed_texts(["hello world", "ab abc"])
+    assert out.shape == (2, TEST_TINY.hidden_size)
+    norms = np.linalg.norm(out, axis=-1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-3)

@@ -1,0 +1,186 @@
+"""Two-process DCN smoke: actually form a ``jax.distributed`` group.
+
+`parallel.dist.maybe_initialize_distributed` is the multi-host entry point;
+this module proves it forms a real process group without TPU pod hardware:
+N CPU processes (one virtual device each) rendezvous at a localhost
+coordinator, build ONE GLOBAL mesh over ``jax.devices()``, and run the
+``sharded_tally`` consensus reduction with the cross-process psum riding
+the distributed backend — the same code path that rides DCN on a pod
+(SURVEY §2.8 "DCN for multi-host slices"; DESIGN.md §multi-host).
+
+Two entry points:
+
+* ``python -m llm_weighted_consensus_tpu.parallel.multihost_smoke`` — one
+  worker process (env: COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID, set
+  by the launcher).  Prints ``MULTIHOST_OK {json}`` on success.
+* ``run_group(num_processes)`` — spawn the workers, collect and
+  cross-check their tallies; used by tests/test_multihost.py and
+  ``__graft_entry__.dryrun_multihost``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+# deterministic fixture: M=4 judges, N=3 candidates
+VOTES = [
+    [1.0, 0.0, 0.0],
+    [0.0, 1.0, 0.0],
+    [0.25, 0.5, 0.25],
+    [0.0, 0.0, 1.0],
+]
+WEIGHTS = [2.0, 1.0, 1.0, 0.5]
+
+
+def expected_confidence():
+    total = sum(WEIGHTS)
+    per = [
+        sum(VOTES[m][n] * WEIGHTS[m] for m in range(len(WEIGHTS)))
+        for n in range(len(VOTES[0]))
+    ]
+    return [p / total for p in per]
+
+
+def worker_main() -> None:
+    """One process of the group (see module doc)."""
+    from .dist import maybe_initialize_distributed
+
+    assert maybe_initialize_distributed(), "MULTIHOST env not set?"
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from .collectives import sharded_tally
+
+    num = int(os.environ["NUM_PROCESSES"])
+    assert jax.process_count() == num, (
+        f"process group has {jax.process_count()} processes, want {num}"
+    )
+    devices = jax.devices()  # GLOBAL list across the group
+    assert len(devices) == num, f"{len(devices)} global devices, want {num}"
+    mesh = Mesh(np.array(devices), ("dp",))
+
+    votes_np = np.array(VOTES, np.float32)
+    weights_np = np.array(WEIGHTS, np.float32)
+
+    def globalize(arr, spec):
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    votes = globalize(votes_np, P("dp", None))
+    weights = globalize(weights_np, P("dp"))
+    conf = sharded_tally(votes, weights, mesh)
+    assert conf.is_fully_replicated
+    out = np.asarray(conf).tolist()
+    print(
+        "MULTIHOST_OK "
+        + json.dumps(
+            {
+                "process_id": jax.process_index(),
+                "num_processes": num,
+                "confidence": out,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_group(
+    num_processes: int = 2, timeout: float = 300.0, attempts: int = 2
+) -> list:
+    """Spawn the worker group; return per-process confidence vectors.
+
+    Raises on any worker failure or cross-process disagreement — this is
+    the pass/fail gate for the DCN smoke.  The coordinator port is probed
+    then released (TOCTOU window before the coordinator re-binds it), so
+    one retry with a fresh port absorbs the rare steal.
+    """
+    last: Exception = RuntimeError("unreachable")
+    for _ in range(attempts):
+        try:
+            return _run_group_once(num_processes, timeout)
+        except RuntimeError as exc:
+            last = exc
+    raise last
+
+
+def _run_group_once(num_processes: int, timeout: float) -> list:
+    coordinator = f"127.0.0.1:{_free_port()}"
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    procs = []
+    for pid in range(num_processes):
+        env = dict(os.environ)
+        env.update(
+            MULTIHOST="1",
+            COORDINATOR_ADDRESS=coordinator,
+            NUM_PROCESSES=str(num_processes),
+            PROCESS_ID=str(pid),
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            PYTHONPATH=repo_root
+            + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        )
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "llm_weighted_consensus_tpu.parallel.multihost_smoke",
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    results = []
+    failures = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+            failures.append(f"process {pid} timed out:\n{out}")
+            continue
+        marker = [
+            line for line in out.splitlines() if line.startswith("MULTIHOST_OK ")
+        ]
+        if proc.returncode != 0 or not marker:
+            failures.append(
+                f"process {pid} rc={proc.returncode}:\n{out[-2000:]}"
+            )
+            continue
+        results.append(json.loads(marker[0][len("MULTIHOST_OK "):]))
+    if failures:
+        raise RuntimeError("DCN smoke failed:\n" + "\n---\n".join(failures))
+    confs = [r["confidence"] for r in sorted(results, key=lambda r: r["process_id"])]
+    first = confs[0]
+    for other in confs[1:]:
+        if any(abs(a - b) > 1e-6 for a, b in zip(first, other)):
+            raise RuntimeError(
+                f"processes disagree on the tally: {confs}"
+            )
+    exp = expected_confidence()
+    if any(abs(a - b) > 1e-5 for a, b in zip(first, exp)):
+        raise RuntimeError(f"tally {first} != expected {exp}")
+    return confs
+
+
+if __name__ == "__main__":
+    worker_main()

@@ -270,21 +270,26 @@ def load_tokenizer(
     vocab_size: int = 30522,
     scheme: Optional[str] = None,
 ) -> BaseTokenizer:
-    """Real tokenizer when a local vocab exists, hash fallback otherwise.
+    """Real tokenizer for ``vocab_path``, hash fallback when none given.
 
     ``*.txt`` -> WordPiece; ``*.model`` / ``*.spm`` -> SentencePiece
     unigram (``scheme`` picks the xlmr/deberta id convention, default
-    xlmr — see models/spm.py).
+    xlmr — see models/spm.py).  A configured path that does not exist is
+    an error, not a silent hash fallback — a typo'd EMBEDDER_VOCAB must
+    not serve garbage tokenization that looks valid.
     """
     if vocab_path:
         import os
 
-        if os.path.exists(vocab_path):
-            if vocab_path.endswith((".model", ".spm")):
-                from .spm import UnigramTokenizer
+        if not os.path.exists(vocab_path):
+            raise FileNotFoundError(
+                f"tokenizer vocab {vocab_path!r} does not exist"
+            )
+        if vocab_path.endswith((".model", ".spm")):
+            from .spm import UnigramTokenizer
 
-                return UnigramTokenizer.from_model_file(
-                    vocab_path, scheme or "xlmr"
-                )
-            return WordPieceTokenizer.from_vocab_file(vocab_path)
+            return UnigramTokenizer.from_model_file(
+                vocab_path, scheme or "xlmr"
+            )
+        return WordPieceTokenizer.from_vocab_file(vocab_path)
     return HashTokenizer(vocab_size)

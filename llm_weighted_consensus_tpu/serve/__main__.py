@@ -219,10 +219,24 @@ def _enable_compile_cache(path: str) -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
-def build_embedder(config: Config):
+def _synthetic_params_allowed(allow_synthetic: bool) -> bool:
+    import os
+
+    return allow_synthetic or str(
+        os.environ.get("LWC_ALLOW_RANDOM_PARAMS", "")
+    ).lower() in ("1", "true", "yes", "on")
+
+
+def build_embedder(config: Config, allow_synthetic: bool = False):
     """The service's device side: an embedder from env config, placed on a
     (dp, tp) mesh when MESH_DP / MESH_TP are set (batches shard over dp,
-    encoder params Megatron-split over tp — parallel/sharding.py)."""
+    encoder params Megatron-split over tp — parallel/sharding.py).
+
+    Serving synthetic state — random-init weights (no EMBEDDER_WEIGHTS) or
+    the hash tokenizer (no real vocab) — produces embeddings that LOOK
+    valid but are garbage; it is refused unless explicitly opted into via
+    ``allow_synthetic`` (set for --fake-upstream demo mode) or
+    ``LWC_ALLOW_RANDOM_PARAMS=1``, and logged loudly even then."""
     if config.compile_cache_dir:
         _enable_compile_cache(config.compile_cache_dir)
     if not config.embedder_model:
@@ -231,6 +245,12 @@ def build_embedder(config: Config):
     from ..models.embedder import TpuEmbedder
     from ..models.spm import scheme_for_model
     from ..models.tokenizer import load_tokenizer
+
+    if config.embedder_model not in PRESETS:
+        raise ValueError(
+            f"EMBEDDER_MODEL={config.embedder_model!r} is not a known "
+            f"preset; valid values: {', '.join(sorted(PRESETS))}"
+        )
 
     params = None
     vocab_path = config.embedder_vocab
@@ -269,6 +289,37 @@ def build_embedder(config: Config):
         ),
         max_tokens=max_tokens,
     )
+    from ..models.tokenizer import HashTokenizer
+
+    synthetic = []
+    if params is None:
+        synthetic.append("random-init weights (no EMBEDDER_WEIGHTS)")
+    if isinstance(embedder.tokenizer, HashTokenizer):
+        synthetic.append(
+            "hash tokenizer (no EMBEDDER_VOCAB and no vocab/spm file "
+            "beside EMBEDDER_WEIGHTS)"
+        )
+    if synthetic:
+        detail = (
+            f"EMBEDDER_MODEL={config.embedder_model} would serve "
+            + " and ".join(synthetic)
+            + " — embeddings and trained-weight lookups would be garbage "
+            "that looks valid."
+        )
+        if not _synthetic_params_allowed(allow_synthetic):
+            raise ValueError(
+                detail
+                + " Point EMBEDDER_WEIGHTS at a checkpoint, or opt into "
+                "synthetic params explicitly with LWC_ALLOW_RANDOM_PARAMS=1 "
+                "(tests/demo only)."
+            )
+        import logging
+
+        logging.getLogger("lwc.serve").warning(
+            "SYNTHETIC EMBEDDER PARAMS: %s Serving anyway "
+            "(LWC_ALLOW_RANDOM_PARAMS / fake-upstream demo mode).",
+            detail,
+        )
     if config.mesh_sp is not None:
         import jax
 
@@ -348,9 +399,13 @@ def build_service(config: Config, fake_upstream: bool = False):
         # moment we could find out, and by then the archive would be lost.
         # A tiny probe, not a full save — re-serializing a just-loaded
         # multi-GB snapshot would double startup IO for nothing.
-        from ..utils.io import probe_writable
+        from ..utils.io import probe_writable_config
 
-        probe_writable(config.archive_path)
+        probe_writable_config(
+            config.archive_path,
+            "ARCHIVE_PATH",
+            "snapshots would be lost at shutdown",
+        )
         if not os.path.exists(config.archive_path):
             store.save(config.archive_path)
     transport = AiohttpTransport()
@@ -366,7 +421,9 @@ def build_service(config: Config, fake_upstream: bool = False):
         archive_fetcher=store,
     )
     model_registry = registry.InMemoryModelRegistry()
-    embedder = build_embedder(config)
+    # --fake-upstream is demo/test mode: synthetic embedder params are
+    # allowed (still logged); production startup refuses them
+    embedder = build_embedder(config, allow_synthetic=fake_upstream)
     batcher = None
     metrics = None
     if embedder is not None:
@@ -393,9 +450,13 @@ def build_service(config: Config, fake_upstream: bool = False):
         else:
             tables = TrainingTableStore()
         if config.tables_path:
-            from ..utils.io import probe_writable
+            from ..utils.io import probe_writable_config
 
-            probe_writable(config.tables_path)
+            probe_writable_config(
+                config.tables_path,
+                "TABLES_PATH",
+                "learned weights would be lost at shutdown",
+            )
         weight_fetchers = WeightFetchers(
             training_table_fetcher=TpuTrainingTableFetcher(
                 embedder, tables, batcher=batcher

@@ -29,3 +29,15 @@ def probe_writable(path: str) -> None:
     with open(probe, "wb") as f:
         f.write(b"")
     os.unlink(probe)
+
+
+def probe_writable_config(path: str, env_name: str, consequence: str) -> None:
+    """``probe_writable`` for an env-configured snapshot path: failures
+    name the variable and what would be lost, so a startup refusal points
+    the operator at the fix."""
+    try:
+        probe_writable(path)
+    except OSError as exc:
+        raise OSError(
+            f"{env_name}={path!r} is not writable ({exc}); {consequence}"
+        ) from exc

@@ -17,6 +17,12 @@ from __graft_entry__ import _apply_virtual_cpu_env  # noqa: E402
 
 _apply_virtual_cpu_env(8)
 
+# Tests build embedders without checkpoints on purpose (random-init +
+# hash tokenizer on the tiny config); opt into the synthetic-params gate
+# that production startup refuses (serve/__main__.py::build_embedder).
+# The refusal itself is tested by deleting this var (test_gateway.py).
+os.environ.setdefault("LWC_ALLOW_RANDOM_PARAMS", "1")
+
 # The environment may pre-import jax pointed at real hardware (sitecustomize
 # in PYTHONPATH); the config update below wins as long as no computation has
 # run yet, which holds at conftest time.  jax stays optional: the pure-core

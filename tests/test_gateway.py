@@ -473,6 +473,121 @@ def test_streaming_consensus_loop_not_blocked():
     go(with_client(app, run))
 
 
+# -- synthetic-params gate (VERDICT r2 item 7) --------------------------------
+
+
+def test_synthetic_params_refused_without_gate(monkeypatch):
+    """Production startup refuses random-init weights + hash tokenizer
+    unless explicitly opted in; the error names the fix."""
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    monkeypatch.delenv("LWC_ALLOW_RANDOM_PARAMS", raising=False)
+    config = Config.from_env(
+        {"EMBEDDER_MODEL": "test-tiny", "EMBEDDER_MAX_TOKENS": "32"}
+    )
+    with pytest.raises(ValueError) as err:
+        build_embedder(config)
+    msg = str(err.value)
+    assert "EMBEDDER_WEIGHTS" in msg
+    assert "LWC_ALLOW_RANDOM_PARAMS" in msg
+    assert "random-init" in msg and "hash tokenizer" in msg
+
+
+def test_synthetic_params_warn_with_gate(monkeypatch, caplog):
+    """With the gate (or fake-upstream demo mode) synthetic params serve,
+    but the startup log shouts about it."""
+    pytest.importorskip("jax")
+    import logging
+
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    monkeypatch.delenv("LWC_ALLOW_RANDOM_PARAMS", raising=False)
+    config = Config.from_env(
+        {"EMBEDDER_MODEL": "test-tiny", "EMBEDDER_MAX_TOKENS": "32"}
+    )
+    with caplog.at_level(logging.WARNING, logger="lwc.serve"):
+        embedder = build_embedder(config, allow_synthetic=True)
+    assert embedder is not None
+    assert any(
+        "SYNTHETIC EMBEDDER PARAMS" in rec.message for rec in caplog.records
+    )
+
+
+def test_real_weights_and_vocab_serve_without_warning(tmp_path, caplog):
+    """A real checkpoint + vocab is NOT synthetic: no gate needed, no
+    warning logged."""
+    pytest.importorskip("jax")
+    import logging
+
+    import jax
+
+    from llm_weighted_consensus_tpu.models import bert
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+    from llm_weighted_consensus_tpu.train import save_checkpoint
+
+    params = bert.init_params(jax.random.PRNGKey(0), TEST_TINY)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(str(ckpt), params)
+    vocab = tmp_path / "vocab.txt"
+    vocab.write_text(
+        "\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "a", "b"]) + "\n"
+    )
+    config = Config.from_env(
+        {
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_WEIGHTS": str(ckpt),
+            "EMBEDDER_VOCAB": str(vocab),
+            "EMBEDDER_MAX_TOKENS": "32",
+        }
+    )
+    with caplog.at_level(logging.WARNING, logger="lwc.serve"):
+        embedder = build_embedder(config)
+    assert embedder is not None
+    assert not [r for r in caplog.records if r.name == "lwc.serve"]
+
+
+def test_missing_vocab_path_errors_instead_of_hash_fallback(tmp_path):
+    """A typo'd EMBEDDER_VOCAB must error at startup, not silently serve
+    hash tokenization (or misdiagnose as 'no EMBEDDER_VOCAB')."""
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    config = Config.from_env(
+        {
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_VOCAB": str(tmp_path / "typo.txt"),
+            "EMBEDDER_MAX_TOKENS": "32",
+        }
+    )
+    with pytest.raises(FileNotFoundError) as err:
+        build_embedder(config)
+    assert "typo.txt" in str(err.value)
+
+
+def test_unknown_embedder_model_names_flag_and_presets():
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    config = Config.from_env({"EMBEDDER_MODEL": "bge-enormous"})
+    with pytest.raises(ValueError) as err:
+        build_embedder(config)
+    msg = str(err.value)
+    assert "EMBEDDER_MODEL" in msg and "bge-enormous" in msg
+    assert "bge-small-en" in msg  # lists valid presets
+
+
+def test_unwritable_archive_path_names_env_var(tmp_path):
+    from llm_weighted_consensus_tpu.serve.__main__ import build_service
+
+    missing = tmp_path / "nope" / "archive.json"
+    config = Config.from_env({"ARCHIVE_PATH": str(missing)})
+    with pytest.raises(OSError) as err:
+        build_service(config, fake_upstream=True)
+    assert "ARCHIVE_PATH" in str(err.value)
+
+
 # -- mesh-configured serving (MESH_DP / MESH_TP) ------------------------------
 
 

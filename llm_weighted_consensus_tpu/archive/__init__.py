@@ -62,9 +62,19 @@ class UnimplementedFetcher(Fetcher):
 
 
 class InMemoryArchive(Fetcher):
-    """Dict-backed archive store, used by tests and the batch re-score path."""
+    """Dict-backed archive store, used by tests and the batch re-score path.
 
-    def __init__(self):
+    ``max_completions`` bounds EACH completion table (chat / score /
+    multichat) with FIFO eviction — a long-running service with
+    ARCHIVE_WRITE on must not grow with traffic forever (the shutdown
+    snapshot re-serializes everything it holds).  Evicting a score
+    completion drops its ballots and request record too (useless without
+    the completion).  ``None`` = unbounded (library use; the service
+    default is ``ARCHIVE_MAX_COMPLETIONS``, serve/config.py).
+    """
+
+    def __init__(self, max_completions: Optional[int] = None):
+        self.max_completions = max_completions
         self._chat: dict = {}
         self._score: dict = {}
         self._multichat: dict = {}
@@ -75,13 +85,38 @@ class InMemoryArchive(Fetcher):
         # score completion id -> originating request params (the training
         # signal source: prompts are embedded for table rows)
         self._score_requests: dict = {}
+        # FIFO of ballot cids not (yet) archived — the O(1) eviction
+        # candidate queue for put_ballot (entries are lazily discarded
+        # when they turn out to be archived by the time they surface)
+        from collections import deque
+
+        self._ballot_orphans = deque()
+
+    def _evict_over_cap(self, table: dict) -> None:
+        if self.max_completions is None:
+            return
+        cap = max(0, self.max_completions)  # negative never drains past 0
+        while len(table) > cap:
+            victim = next(iter(table))  # dicts preserve insertion order
+            table.pop(victim)
+            if table is self._score:
+                self._ballots.pop(victim, None)
+                self._score_requests.pop(victim, None)
+
+    def enforce_cap(self) -> None:
+        """Apply the cap to every table now (e.g. after loading an
+        over-cap snapshot or lowering ``max_completions``)."""
+        for table in (self._chat, self._score, self._multichat):
+            self._evict_over_cap(table)
 
     def put_chat(self, completion) -> str:
         self._chat[completion.id] = completion
+        self._evict_over_cap(self._chat)
         return completion.id
 
     def put_score(self, completion) -> str:
         self._score[completion.id] = completion
+        self._evict_over_cap(self._score)
         return completion.id
 
     def put_score_request(self, completion_id: str, params) -> None:
@@ -109,26 +144,28 @@ class InMemoryArchive(Fetcher):
     ) -> None:
         """ScoreClient.ballot_sink-shaped recorder:
         ``ScoreClient(..., ballot_sink=store.put_ballot)``."""
+        if completion_id not in self._ballots:
+            self._ballot_orphans.append(completion_id)
         self._ballots.setdefault(completion_id, {})[judge_index] = list(
             key_indices
         )
         while len(self._ballots) > self.MAX_BALLOT_COMPLETIONS:
             # the cap bounds ORPHANS (streaming requests whose completions
-            # never get archived), oldest first.  Archived completions'
+            # never get archived), oldest first via the FIFO — O(1) per
+            # eviction, not a scan of every key.  Archived completions'
             # ballots — and the in-flight request being recorded right now
             # — are never evicted: revote needs the former, put_score
             # hasn't had its chance at the latter.  When only those
             # remain, growth is legitimate (it tracks the archive's size).
-            victim = next(
-                (
-                    c
-                    for c in self._ballots
-                    if c not in self._score and c != completion_id
-                ),
-                None,
-            )
-            if victim is None:
+            if not self._ballot_orphans:
                 break
+            victim = self._ballot_orphans[0]
+            if victim == completion_id:
+                break  # newest entry: only non-evictable ballots remain
+            self._ballot_orphans.popleft()
+            if victim in self._score or victim not in self._ballots:
+                # archived since queued (keep forever) or already dropped
+                continue
             self._ballots.pop(victim)
 
     def score_ballots(self, completion_id: str) -> Optional[dict]:
@@ -136,6 +173,7 @@ class InMemoryArchive(Fetcher):
 
     def put_multichat(self, completion) -> str:
         self._multichat[completion.id] = completion
+        self._evict_over_cap(self._multichat)
         return completion.id
 
     def chat_ids(self) -> list:

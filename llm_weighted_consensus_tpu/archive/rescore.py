@@ -147,7 +147,9 @@ def rescore_archive(
     ids = list(ids if ids is not None else store.score_ids())
     groups: dict = {}
     for cid in ids:
-        completion = store._score[cid]
+        completion = store.score_completion(cid)
+        if completion is None:  # evicted/unknown id: nothing to re-tally
+            continue
         votes, weights, mask = vote_matrix(completion)
         if weight_overrides:
             for i, choice in enumerate(
@@ -192,7 +194,9 @@ def _revote_group(store, rows, batch_votes, batch_mask, shape):
     valid = np.zeros((b, m, MAX_LOGPROB_FAN), dtype=np.float32)
     use = np.zeros((b, m), dtype=bool)
     for bi, (completion_id, *_rest) in enumerate(rows):
-        completion = store._score[completion_id]
+        completion = store.score_completion(completion_id)
+        if completion is None:  # vanished mid-pass: keep stored votes
+            continue
         ballots = store.score_ballots(completion_id)
         lp[bi], cid[bi], valid[bi], use[bi] = revote_inputs(
             completion, ballots, m, n
@@ -217,7 +221,7 @@ def apply_rescore(store, results: dict) -> int:
     objects (the checkpoint-update step).  Returns completions updated."""
     updated = 0
     for cid, scores in results.items():
-        completion = store._score.get(cid)
+        completion = store.score_completion(cid)
         if completion is None:
             continue
         n = len(scores["confidence"])

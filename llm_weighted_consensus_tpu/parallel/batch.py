@@ -26,9 +26,11 @@ def rescore_batch(
 ):
     """votes[B, M, N], weights[B, M] -> (choice_weight[B, N], conf[B, N]).
 
-    With a mesh, B shards over ``dp`` (pad to a multiple); without, runs on
-    the default device.  The per-request tallies are independent, so the
-    only comms are the initial shard placement.
+    With a mesh, B shards over EVERY mesh axis (pad to a multiple);
+    without, runs on the default device.  The per-request tallies are
+    independent — embarrassingly parallel over B — so any mesh shape
+    (dp×tp serving, dp×sp sequence-parallel serving) flattens into one
+    batch axis and the only comms are the initial shard placement.
     """
     b = votes.shape[0]
     if vote_mask is None:
@@ -37,13 +39,14 @@ def rescore_batch(
         return consensus.tally_batch(
             jnp.asarray(votes), jnp.asarray(weights), jnp.asarray(vote_mask)
         )
-    dp = mesh.shape["dp"] * mesh.shape.get("tp", 1)
-    pad = (-b) % dp
+    axes = tuple(mesh.axis_names)
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+    pad = (-b) % n_dev
     if pad:
         votes = np.pad(votes, ((0, pad), (0, 0), (0, 0)))
         weights = np.pad(weights, ((0, pad), (0, 0)))
         vote_mask = np.pad(vote_mask, ((0, pad), (0, 0)))
-    sharding = NamedSharding(mesh, P(("dp", "tp")))
+    sharding = NamedSharding(mesh, P(axes))
     vs = jax.device_put(jnp.asarray(votes), sharding)
     ws = jax.device_put(jnp.asarray(weights), sharding)
     ms = jax.device_put(jnp.asarray(vote_mask), sharding)

@@ -39,8 +39,15 @@ def _rescore_handler(store, lock, mesh=None):
     Body (all optional): {"weight_overrides": {judge id: weight},
     "ids": [completion ids], "revote": bool (re-extract soft votes from
     stored logprobs), "apply": bool (write results back into the archive),
-    "include_results": bool}.  Runs on an executor under the shared
-    archive-mutation lock.
+    "include_results": bool}.
+
+    Locking: the device compute runs on an executor WITHOUT the lock —
+    it reads only fields no other writer touches (judge votes/weights;
+    ``apply`` writes candidate fields, ``learn`` writes tables), so a 10k
+    re-score doesn't block archiving writes.  ``apply`` then runs ON THE
+    EVENT LOOP under the lock: sync code on the loop is atomic w.r.t.
+    every request handler, so no reader can observe a half-applied
+    completion (weight updated, confidence not).
     """
     from ..archive.rescore import apply_rescore, rescore_archive
     from ..utils import jsonutil
@@ -83,22 +90,20 @@ def _rescore_handler(store, lock, mesh=None):
                 )
 
         def run():
-            results = rescore_archive(
+            return rescore_archive(
                 store,
                 mesh=mesh,
                 weight_overrides=overrides or None,
                 ids=ids,
                 revote=revote,
             )
-            applied = apply_rescore(store, results) if apply else 0
-            return results, applied
 
-        # the lock serializes archive mutations (apply writes into live
-        # wire objects other handlers read)
-        async with lock:
-            results, applied = (
-                await asyncio.get_running_loop().run_in_executor(None, run)
-            )
+        results = await asyncio.get_running_loop().run_in_executor(None, run)
+        applied = 0
+        if apply:
+            # on-loop + locked: atomic for readers, serialized vs learn
+            async with lock:
+                applied = apply_rescore(store, results)
         out = {"rescored": len(results), "applied": applied}
         if include:
             out["results"] = results
@@ -367,13 +372,23 @@ class _ArchivingClient:
     becomes referenceable by later requests); everything else delegates.
     ``put(result, params)`` receives the request too — the score path
     archives it beside the completion, feeding training-table learning
-    (weights/learning.py).  Streaming responses are consumed by the HTTP
-    caller chunk-by-chunk and are not teed into the archive — unary-only,
-    by design."""
+    (weights/learning.py).
 
-    def __init__(self, inner, put):
+    Streaming: by default streamed responses are consumed by the HTTP
+    caller chunk-by-chunk and are NOT archived (the reference archives
+    nothing, so parity holds; only unary callers feed rescore/learning).
+    With ``stream_fold`` set (ARCHIVE_STREAMING=1), the chunk stream is
+    teed into the merge algebra — each chunk ``push``ed into a running
+    aggregate, the folded unary archived at clean stream end (``unary =
+    fold(chunks)``, the types/base.py contract, mirroring how unary is
+    *defined* in the reference, chat client.rs:170-191).  A stream the
+    client abandons mid-way archives nothing: a partial fold would be
+    indistinguishable from a complete completion."""
+
+    def __init__(self, inner, put, stream_fold=None):
         self._inner = inner
         self._put = put
+        self._stream_fold = stream_fold
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -382,6 +397,41 @@ class _ArchivingClient:
         result = await self._inner.create_unary(ctx, params)
         self._put(result, params)
         return result
+
+    async def create_streaming(self, ctx, params):
+        stream = await self._inner.create_streaming(ctx, params)
+        if self._stream_fold is None:
+            return stream
+        return self._tee(stream, params)
+
+    async def _tee(self, stream, params):
+        aggregate = None
+        foldable = True
+        completed = False
+        try:
+            async for chunk in stream:
+                # error items (e.g. ChatError frames the chat stream
+                # yields mid-stream) pass through to the client but
+                # poison the fold: an errored stream is not a complete
+                # completion, so nothing is archived — error isolation
+                # is identical with and without the tee
+                if foldable and isinstance(chunk, Exception):
+                    foldable = False
+                elif foldable and aggregate is None:
+                    aggregate = chunk.clone()
+                elif foldable:
+                    aggregate.push(chunk)
+                yield chunk
+            completed = True
+        finally:
+            # propagate close (client disconnects surface as
+            # GeneratorExit here) so the upstream connection is released
+            # promptly — same contract as gateway._respond_streaming
+            aclose = getattr(stream, "aclose", None)
+            if aclose is not None:
+                await aclose()
+        if completed and foldable and aggregate is not None:
+            self._put(self._stream_fold(aggregate), params)
 
 
 def build_service(config: Config, fake_upstream: bool = False):
@@ -394,6 +444,9 @@ def build_service(config: Config, fake_upstream: bool = False):
         store = archive.InMemoryArchive.load(config.archive_path)
     else:
         store = archive.InMemoryArchive()
+    # bound service memory growth (ARCHIVE_MAX_COMPLETIONS; 0 = unbounded)
+    store.max_completions = config.archive_max_completions or None
+    store.enforce_cap()  # an over-cap loaded snapshot trims at startup
     if config.archive_path:
         # fail FAST on an unwritable path: the shutdown save is the last
         # moment we could find out, and by then the archive would be lost.
@@ -476,17 +529,32 @@ def build_service(config: Config, fake_upstream: bool = False):
     )
     gw_chat, gw_score, gw_multichat = chat_client, score_client, multichat_client
     if config.archive_write:
+        from ..types import chat_response, multichat_response, score_response
 
         def put_score(result, params):
             store.put_score(result)
             store.put_score_request(result.id, params)
 
+        def fold(unary_cls):
+            # ARCHIVE_STREAMING: tee streams into the merge-algebra fold
+            if not config.archive_streaming:
+                return None
+            return unary_cls.from_streaming
+
         gw_chat = _ArchivingClient(
-            chat_client, lambda result, params: store.put_chat(result)
+            chat_client,
+            lambda result, params: store.put_chat(result),
+            stream_fold=fold(chat_response.ChatCompletion),
         )
-        gw_score = _ArchivingClient(score_client, put_score)
+        gw_score = _ArchivingClient(
+            score_client,
+            put_score,
+            stream_fold=fold(score_response.ChatCompletion),
+        )
         gw_multichat = _ArchivingClient(
-            multichat_client, lambda result, params: store.put_multichat(result)
+            multichat_client,
+            lambda result, params: store.put_multichat(result),
+            stream_fold=fold(multichat_response.ChatCompletion),
         )
     app = build_app(
         gw_chat,
@@ -503,7 +571,12 @@ def build_service(config: Config, fake_upstream: bool = False):
     app.router.add_post(
         "/archive/rescore",
         _rescore_handler(
-            store, archive_lock, mesh=getattr(embedder, "mesh", None)
+            store,
+            archive_lock,
+            # MESH_SP serving exposes sp_mesh, dp/tp serving exposes mesh;
+            # the batched tally shards over every axis of either
+            mesh=getattr(embedder, "mesh", None)
+            or getattr(embedder, "sp_mesh", None),
         ),
     )
     if tables is not None:

@@ -47,6 +47,15 @@ TPU additions:
   set; ``ARCHIVE_WRITE=0`` disables.  ``POST /archive/rescore`` re-tallies
   archived completions on device (weight overrides, optional logprob
   revote, optional write-back).
+* ``ARCHIVE_STREAMING`` — with ``ARCHIVE_WRITE``, also archive STREAMED
+  completions: the gateway tees each chunk stream into the merge-algebra
+  fold and archives the unary form at stream end (``unary =
+  fold(chunks)`` — types/base.py).  Off by default: real traffic is
+  mostly streaming, so this retains every served response.
+* ``ARCHIVE_MAX_COMPLETIONS`` — FIFO cap per archive table (chat / score
+  / multichat), bounding a long-running service's memory; evicting a
+  score completion drops its ballots + request record.  ``0`` =
+  unbounded.  Default 65536.
 * ``TABLES_PATH`` — .npz snapshot for the judge training tables: loaded
   at startup when present, saved on graceful shutdown.  With an embedder
   configured, ``POST /weights/learn`` builds rows from the archive into
@@ -67,6 +76,15 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..utils import jsonutil
+
+
+def _non_negative_int(env: dict, name: str, default: int) -> int:
+    value = int(env.get(name, default))
+    if value < 0:
+        raise ValueError(
+            f"{name}={value} must be >= 0 (0 = unbounded)"
+        )
+    return value
 
 
 def load_dotenv(path: str = ".env") -> None:
@@ -121,6 +139,12 @@ class Config:
     profile_dir: Optional[str] = None
     archive_path: Optional[str] = None
     archive_write: bool = False
+    # also archive STREAMED completions by teeing the chunk stream into
+    # the fold (unary = fold(chunks)) at stream end; off by default —
+    # folding retains every streamed response in memory
+    archive_streaming: bool = False
+    # FIFO cap per completion table; 0 = unbounded
+    archive_max_completions: int = 65536
     tables_path: Optional[str] = None
     batch_window_ms: float = 3.0
     batch_max: int = 64
@@ -186,6 +210,13 @@ class Config:
                     env.get("ARCHIVE_WRITE", "1" if env.get("ARCHIVE_PATH") else "0")
                 ).lower()
                 in ("1", "true", "yes", "on")
+            ),
+            archive_streaming=(
+                str(env.get("ARCHIVE_STREAMING", "0")).lower()
+                in ("1", "true", "yes", "on")
+            ),
+            archive_max_completions=_non_negative_int(
+                env, "ARCHIVE_MAX_COMPLETIONS", 65536
             ),
             tables_path=env.get("TABLES_PATH"),
             batch_window_ms=get_f("BATCH_WINDOW_MS", 3.0),

@@ -922,6 +922,236 @@ def test_archive_write_config_defaults():
     assert bare.archive_write is False
     explicit = Config.from_env({"ARCHIVE_WRITE": "1"})
     assert explicit.archive_write is True
+    # streaming tee + cap flags
+    assert bare.archive_streaming is False
+    assert bare.archive_max_completions == 65536
+    custom = Config.from_env(
+        {"ARCHIVE_STREAMING": "1", "ARCHIVE_MAX_COMPLETIONS": "100"}
+    )
+    assert custom.archive_streaming is True
+    assert custom.archive_max_completions == 100
+    with pytest.raises(ValueError):  # negative cap is a config error
+        Config.from_env({"ARCHIVE_MAX_COMPLETIONS": "-1"})
+
+
+def test_archive_cap_fifo_eviction():
+    """max_completions bounds each table FIFO; evicting a score completion
+    drops its ballots + request record (ADVICE r2: unbounded growth)."""
+    from types import SimpleNamespace
+
+    store = archive.InMemoryArchive(max_completions=3)
+    for i in range(5):
+        cid = f"scrcpl-{i}"
+        store.put_ballot(cid, 0, [("`A`", 0), ("`B`", 1)])
+        store.put_score(SimpleNamespace(id=cid))
+        store.put_score_request(cid, object())
+    assert store.score_ids() == ["scrcpl-2", "scrcpl-3", "scrcpl-4"]
+    assert store.score_ballots("scrcpl-0") is None
+    assert store.score_request("scrcpl-0") is None
+    assert store.score_ballots("scrcpl-4") is not None
+    # chat and multichat tables have their own FIFOs
+    for i in range(5):
+        store.put_chat(SimpleNamespace(id=f"chtcpl-{i}"))
+        store.put_multichat(SimpleNamespace(id=f"mchcpl-{i}"))
+    assert store.chat_ids() == ["chtcpl-2", "chtcpl-3", "chtcpl-4"]
+    assert store.multichat_ids() == ["mchcpl-2", "mchcpl-3", "mchcpl-4"]
+    # enforce_cap trims an over-cap store after the cap is lowered
+    store.max_completions = 1
+    store.enforce_cap()
+    assert store.score_ids() == ["scrcpl-4"]
+
+
+def _make_archiving_score(scripts, stream_fold):
+    from llm_weighted_consensus_tpu.serve.__main__ import _ArchivingClient
+
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=store,
+        rng_factory=lambda: random.Random(SEED),
+    )
+
+    def put_score(result, params):
+        store.put_score(result)
+        store.put_score_request(result.id, params)
+
+    return _ArchivingClient(score, put_score, stream_fold=stream_fold), store
+
+
+def test_archive_streaming_tee_folds_completed_stream():
+    """ARCHIVE_STREAMING: a fully-consumed stream archives its folded
+    unary form (unary = fold(chunks) — the merge-algebra contract)."""
+    from llm_weighted_consensus_tpu.types import score_response
+    from llm_weighted_consensus_tpu.types.score_request import (
+        ChatCompletionCreateParams as SP,
+    )
+
+    keys = ballot_keys(2)
+    client, store = _make_archiving_score(
+        [Script([chunk_obj(f"pick {keys[0]}", model="j1", finish="stop")])],
+        score_response.ChatCompletion.from_streaming,
+    )
+    params = SP.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "q"}],
+            "model": inline_model([{"model": "j1"}]),
+            "choices": ["first", "second"],
+        }
+    )
+
+    async def run():
+        stream = await client.create_streaming(None, params)
+        async for _ in stream:
+            pass
+
+    go(run())
+    [cid] = store.score_ids()
+    completion = store.score_completion(cid)
+    assert completion.id == cid
+    # the folded unary carries the full consensus result: two candidates
+    # with confidence and the judge choice with its vote
+    candidates = [c for c in completion.choices if c.model_index is None]
+    assert len(candidates) == 2
+    assert float(candidates[0].confidence) == pytest.approx(1.0)
+    judges = [c for c in completion.choices if c.model_index is not None]
+    assert judges and judges[0].message.vote is not None
+    # the request archived beside it feeds training-table learning
+    assert store.score_request(cid) is not None
+
+
+def test_archive_streaming_error_item_passes_through_unarchived():
+    """Mid-stream error items (ChatError frames) pass through to the
+    client unchanged and poison the fold — the errored stream is not
+    archived, and the tee never crashes the client-facing stream."""
+    from llm_weighted_consensus_tpu.errors import ChatError
+    from llm_weighted_consensus_tpu.serve.__main__ import _ArchivingClient
+    from llm_weighted_consensus_tpu.types import chat_response
+
+    chunk = chat_response.ChatCompletionChunk.from_json_obj(
+        {
+            "id": "c1",
+            "object": "chat.completion.chunk",
+            "created": 0,
+            "model": "m",
+            "choices": [
+                {"index": 0, "delta": {"content": "hi"}, "finish_reason": None}
+            ],
+        }
+    )
+    error = ChatError("deserialize_chat_completion_chunk", "bad frame")
+    closed = []
+
+    async def inner_stream():
+        try:
+            yield chunk
+            yield error
+            yield chunk.clone()
+        finally:
+            closed.append(True)
+
+    class Inner:
+        async def create_streaming(self, ctx, params):
+            return inner_stream()
+
+    archived = []
+    client = _ArchivingClient(
+        Inner(),
+        lambda result, params: archived.append(result),
+        stream_fold=chat_response.ChatCompletion.from_streaming,
+    )
+
+    async def run():
+        stream = await client.create_streaming(None, None)
+        return [item async for item in stream]
+
+    items = go(run())
+    assert len(items) == 3 and items[1] is error
+    assert archived == []  # errored stream: nothing archived
+    assert closed == [True]  # inner stream released
+
+
+def test_archive_streaming_tee_closes_inner_on_abandon():
+    """Client disconnect (aclose on the tee) propagates to the inner
+    stream so the upstream connection is released promptly."""
+    from llm_weighted_consensus_tpu.serve.__main__ import _ArchivingClient
+    from llm_weighted_consensus_tpu.types import chat_response
+
+    chunk = chat_response.ChatCompletionChunk.from_json_obj(
+        {
+            "id": "c1",
+            "object": "chat.completion.chunk",
+            "created": 0,
+            "model": "m",
+            "choices": [
+                {"index": 0, "delta": {"content": "hi"}, "finish_reason": None}
+            ],
+        }
+    )
+    closed = []
+
+    async def inner_stream():
+        try:
+            while True:
+                yield chunk
+        finally:
+            closed.append(True)
+
+    class Inner:
+        async def create_streaming(self, ctx, params):
+            return inner_stream()
+
+    archived = []
+    client = _ArchivingClient(
+        Inner(),
+        lambda result, params: archived.append(result),
+        stream_fold=chat_response.ChatCompletion.from_streaming,
+    )
+
+    async def run():
+        stream = await client.create_streaming(None, None)
+        async for _ in stream:
+            break
+        await stream.aclose()
+
+    go(run())
+    assert closed == [True]
+    assert archived == []
+
+
+def test_archive_streaming_abandoned_stream_not_archived():
+    """A stream the client abandons mid-way archives nothing — a partial
+    fold would look like a complete completion."""
+    from llm_weighted_consensus_tpu.types import score_response
+    from llm_weighted_consensus_tpu.types.score_request import (
+        ChatCompletionCreateParams as SP,
+    )
+
+    keys = ballot_keys(2)
+    client, store = _make_archiving_score(
+        [Script([chunk_obj(f"pick {keys[0]}", model="j1", finish="stop")])],
+        score_response.ChatCompletion.from_streaming,
+    )
+    params = SP.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "q"}],
+            "model": inline_model([{"model": "j1"}]),
+            "choices": ["first", "second"],
+        }
+    )
+
+    async def run():
+        stream = await client.create_streaming(None, params)
+        async for _ in stream:
+            break  # abandon after the first chunk
+        await stream.aclose()
+
+    go(run())
+    assert store.score_ids() == []
 
 
 def test_archive_rescore_endpoint():

@@ -98,6 +98,25 @@ def test_rescore_batch_mesh_matches_local(dp_mesh):
     assert conf_mesh.shape == (b, n)
 
 
+def test_rescore_batch_arbitrary_axis_names():
+    """rescore_batch shards over EVERY axis of any mesh — the sp-serving
+    mesh ("dp", "sp") included, so MESH_SP services re-score sharded
+    (ADVICE r2: sp_mesh used to silently run unsharded)."""
+    from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
+
+    sp_mesh = make_mesh(dp=2, tp=4, names=("dp", "sp"))
+    rng = np.random.default_rng(9)
+    b, m, n = 11, 3, 4
+    v = rng.random((b, m, n)).astype(np.float32)
+    v /= v.sum(axis=2, keepdims=True)
+    w = np.ones((b, m), dtype=np.float32)
+    _, conf_mesh = batch_mod.rescore_batch(v, w, mesh=sp_mesh)
+    _, conf_local = batch_mod.rescore_batch(v, w)
+    np.testing.assert_allclose(
+        np.asarray(conf_mesh), np.asarray(conf_local), atol=1e-6
+    )
+
+
 def test_contrastive_training_reduces_loss(dp_mesh):
     from llm_weighted_consensus_tpu import train
 

@@ -1,0 +1,357 @@
+#!/usr/bin/env python
+"""Gateway-level benchmark: answers/sec + p50 THROUGH the HTTP service.
+
+Every number in bench.py / bench_all.py calls the embedder/clients
+directly; this harness measures the product surface instead (VERDICT r2
+item 3): real aiohttp server on a localhost TCP socket, JSON
+serialization, SSE framing, executor hops, and the micro-batcher all
+inside the timed path.  Three served endpoints:
+
+1. ``/consensus`` — the device self-consistency scorer over HTTP: R
+   concurrent clients each posting N=64 candidate texts.  The direct-call
+   twin (embedder.consensus_confidence, same shapes — bench.py's metric)
+   runs alongside, and the JSON reports the served/direct delta, which is
+   the true cost of the HTTP+batcher edge.
+2. ``/score/completions`` (streaming, fake upstream) — the reference's
+   primary path (src/main.rs:189-232): ballot prompt injection, judge SSE
+   round-trip, vote extraction, tally, SSE out with [DONE].
+3. ``/multichat/completions`` (unary, ``consensus: true``) — N-generator
+   fan-out + device consensus overlay (BASELINE config 2's serving form).
+
+Prints ONE JSON line per endpoint: {"endpoint", "value", "unit",
+"p50_ms", ...}.  Flags: --model (default bge-large-en on TPU, test-tiny
+elsewhere), --n, --seq, --requests, --concurrency, --quick.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from bench import BENCH_WORDS, bench_tokenizer, make_requests  # noqa: E402
+
+
+def emit(endpoint: str, value: float, unit: str, **extra) -> None:
+    print(
+        json.dumps(
+            {
+                "endpoint": endpoint,
+                "value": round(value, 3),
+                "unit": unit,
+                **extra,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _percentiles(lat_ms: list) -> dict:
+    lat = sorted(lat_ms)
+    return {
+        "p50_ms": round(statistics.median(lat), 2),
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2),
+    }
+
+
+async def _start_service(model: str, window_ms: float):
+    """The real service on real localhost TCP sockets (fake upstream
+    included), exactly as ``python -m ...serve --fake-upstream`` wires it."""
+    from aiohttp import web
+    from aiohttp.test_utils import unused_port
+
+    from llm_weighted_consensus_tpu.serve import Config
+    from llm_weighted_consensus_tpu.serve.__main__ import (
+        FAKE_PORT,
+        _fake_upstream,
+        build_service,
+    )
+
+    config = Config.from_env(
+        {
+            "EMBEDDER_MODEL": model,
+            "BATCH_WINDOW_MS": str(window_ms),
+        }
+    )
+    app = build_service(config, fake_upstream=True)
+    # the embedder in build_service used the env tokenizer path; give it
+    # the bench WordPiece vocab so tokenization cost matches bench.py
+    from llm_weighted_consensus_tpu.serve.gateway import BATCHER_KEY
+
+    embedder = app[BATCHER_KEY].embedder if BATCHER_KEY in app else None
+    if embedder is not None:
+        embedder.tokenizer = bench_tokenizer()
+
+    fake_app = web.Application()
+    fake_app.router.add_post("/v1/chat/completions", _fake_upstream)
+    fake_runner = web.AppRunner(fake_app)
+    await fake_runner.setup()
+    await web.TCPSite(fake_runner, "127.0.0.1", FAKE_PORT).start()
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    port = unused_port()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    return runner, fake_runner, port, embedder
+
+
+async def _drive(session, url, bodies, concurrency, warmup_bursts=2):
+    """Fire ``bodies`` at ``url`` with bounded concurrency; returns
+    (total_seconds, per-request latencies ms).
+
+    Warm-up: ``warmup_bursts`` full-concurrency bursts run UNTIMED first,
+    so jit specializations for the batcher group sizes the burst produces
+    (power-of-two buckets) compile outside the measured window — the
+    same discipline bench.py applies to its shapes."""
+    sem = asyncio.Semaphore(concurrency)
+    lat = []
+
+    async def one(body, record=True):
+        async with sem:
+            t0 = time.perf_counter()
+            async with session.post(url, data=body) as resp:
+                await resp.read()
+                assert resp.status == 200, await resp.text()
+            if record:
+                lat.append((time.perf_counter() - t0) * 1e3)
+
+    for _ in range(warmup_bursts):
+        burst = (bodies * ((concurrency // len(bodies)) + 1))[:concurrency]
+        await asyncio.gather(*(one(b, record=False) for b in burst))
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(b) for b in bodies))
+    return time.perf_counter() - t0, lat
+
+
+async def bench_consensus_endpoint(
+    session, base, embedder, n, seq, requests, concurrency
+):
+    """Served /consensus vs the direct-call twin on identical inputs."""
+    reqs = make_requests(requests, n)
+    bodies = [
+        json.dumps({"input": texts, "temperature": 0.05}) for texts in reqs
+    ]
+    # deterministic warm-up: compile every power-of-two R bucket the
+    # batcher can produce under this concurrency, plus the r=1 path
+    loop = asyncio.get_running_loop()
+    ids, mask = embedder.tokenize(reqs[0])
+    seq = ids.shape[1]
+    r_bucket = 1
+    while True:
+        r_eff = min(r_bucket, concurrency)
+        rep_ids = np.tile(ids[None], (r_eff, 1, 1))
+        rep_mask = np.tile(mask[None], (r_eff, 1, 1))
+        await loop.run_in_executor(
+            None,
+            lambda ri=rep_ids, rm=rep_mask: np.asarray(
+                embedder.consensus_confidence_tokens_many(ri, rm, 0.05)
+            ),
+        )
+        if r_bucket >= concurrency:
+            break
+        r_bucket *= 2
+    await loop.run_in_executor(
+        None, lambda: np.asarray(embedder.consensus_confidence(reqs[0]))
+    )
+
+    total, lat = await _drive(
+        session, base + "/consensus", bodies, concurrency
+    )
+    served = len(bodies) / total
+
+    # direct-call twin (bench.py's pipelined shape): same texts, same
+    # embedder, no HTTP — the delta IS the gateway overhead
+    from concurrent.futures import ThreadPoolExecutor
+
+    def direct(texts):
+        return embedder.consensus_confidence(texts, temperature=0.05)
+
+    direct(reqs[0])  # warm
+    pool = ThreadPoolExecutor(8)
+    t0 = time.perf_counter()
+    futs = [pool.submit(np.asarray, direct(texts)) for texts in reqs]
+    for f in futs:
+        f.result()
+    direct_rate = len(reqs) / (time.perf_counter() - t0)
+    pool.shutdown()
+
+    emit(
+        "/consensus",
+        served,
+        "answers/sec",
+        **_percentiles(lat),
+        n_candidates=n,
+        requests=len(bodies),
+        concurrency=concurrency,
+        direct_call_answers_per_sec=round(direct_rate, 3),
+        served_vs_direct=round(served / direct_rate, 3),
+        note=(
+            "served = aiohttp + JSON + micro-batcher + device; "
+            "direct = same shapes via embedder.consensus_confidence "
+            "(bench.py's pipelined path)"
+        ),
+    )
+    return served
+
+
+async def bench_score_endpoint(session, base, requests, concurrency):
+    """Streaming /score/completions against the local fake upstream."""
+    rng = np.random.default_rng(3)
+    bodies = []
+    for i in range(requests):
+        words = " ".join(rng.choice(BENCH_WORDS, size=24).tolist())
+        bodies.append(
+            json.dumps(
+                {
+                    "stream": True,
+                    "messages": [{"role": "user", "content": words}],
+                    "model": {"llms": [{"model": "fake-judge"}]},
+                    "choices": [f"candidate a {i}", f"candidate b {i}"],
+                }
+            )
+        )
+    async with session.post(
+        base + "/score/completions", data=bodies[0]
+    ) as resp:
+        assert resp.status == 200
+        await resp.read()
+    total, lat = await _drive(
+        session, base + "/score/completions", bodies, concurrency
+    )
+    emit(
+        "/score/completions",
+        len(bodies) / total,
+        "requests/sec",
+        **_percentiles(lat),
+        requests=len(bodies),
+        concurrency=concurrency,
+        note=(
+            "streaming SSE incl. [DONE]; 1 judge via local fake upstream "
+            "(ballot round-trip + vote extraction + tally per request)"
+        ),
+    )
+
+
+async def bench_multichat_endpoint(
+    session, base, embedder, requests, concurrency, generators=4
+):
+    """Unary /multichat/completions with the device consensus overlay."""
+    if embedder is None:
+        return
+    bodies = []
+    for i in range(requests):
+        bodies.append(
+            json.dumps(
+                {
+                    "consensus": True,
+                    "messages": [
+                        {"role": "user", "content": f"question {i}"}
+                    ],
+                    "model": {
+                        "llms": [
+                            {"model": f"fake-gen-{g}"}
+                            for g in range(generators)
+                        ]
+                    },
+                }
+            )
+        )
+    async with session.post(
+        base + "/multichat/completions", data=bodies[0]
+    ) as resp:
+        assert resp.status == 200
+        body = await resp.json()
+        assert "consensus" in body, "consensus overlay missing"
+    total, lat = await _drive(
+        session,
+        base + "/multichat/completions",
+        bodies,
+        concurrency,
+        # the consensus overlay's device shapes (n=generators) are only
+        # reachable through the endpoint, so give the bursts one extra
+        # pass to compile every bucket before the timed window
+        warmup_bursts=3,
+    )
+    emit(
+        "/multichat/completions",
+        len(bodies) / total,
+        "requests/sec",
+        **_percentiles(lat),
+        requests=len(bodies),
+        concurrency=concurrency,
+        generators=generators,
+        note=(
+            "unary multichat: N-generator fan-out via fake upstream + "
+            "fused device consensus overlay (batched across concurrent "
+            "requests)"
+        ),
+    )
+
+
+async def main_async(args) -> None:
+    import aiohttp
+
+    runner, fake_runner, port, embedder = await _start_service(
+        args.model, args.window_ms
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession(
+            headers={"content-type": "application/json"}
+        ) as session:
+            if embedder is not None:
+                await bench_consensus_endpoint(
+                    session,
+                    base,
+                    embedder,
+                    args.n,
+                    args.seq,
+                    args.requests,
+                    args.concurrency,
+                )
+            await bench_score_endpoint(
+                session, base, args.requests, args.concurrency
+            )
+            await bench_multichat_endpoint(
+                session, base, embedder, args.requests, args.concurrency
+            )
+    finally:
+        await runner.cleanup()
+        await fake_runner.cleanup()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    try:
+        import jax
+
+        default_model = (
+            "bge-large-en" if jax.default_backend() == "tpu" else "test-tiny"
+        )
+    except Exception:
+        default_model = "test-tiny"
+    parser.add_argument("--model", default=default_model)
+    parser.add_argument("--n", type=int, default=64)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--requests", type=int, default=100)
+    parser.add_argument("--concurrency", type=int, default=16)
+    parser.add_argument("--window-ms", type=float, default=3.0)
+    parser.add_argument(
+        "--quick", action="store_true", help="small counts for CI/CPU"
+    )
+    args = parser.parse_args()
+    if args.quick:
+        args.requests = min(args.requests, 20)
+        args.n = min(args.n, 8)
+    asyncio.run(main_async(args))
+
+
+if __name__ == "__main__":
+    main()

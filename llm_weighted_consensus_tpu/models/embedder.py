@@ -53,20 +53,28 @@ def _embed_and_vote_many(
     R concurrent requests share ONE device dispatch (dynamic batching —
     the encoder sees one [R*N, S] batch), amortizing the host<->device
     round-trip that dominates single-request latency on tunneled links.
-    Scoring uses the same fused kernel as the single-request path (one
-    scorer implementation; R is small so the unrolled loop is cheap).
-    Rows past ``r*n`` are dp-alignment padding, sliced off pre-vote."""
-    from ..ops.kernels import fused_cosine_vote
+    The vote is one R-batched einsum + softmax (same numerics as
+    ``ops.similarity.cosine_consensus_vote``) rather than R unrolled
+    kernel calls — compile time stays flat in R, and the caller buckets R
+    to a power of two so only log2 specializations ever compile.  Rows
+    past ``r*n`` are bucket/dp-alignment padding, sliced off pre-vote."""
+    from ..ops.similarity import l2_normalize
 
     emb = bert.embed(params, ids, mask, config, pooling=pooling)
     emb = emb[: r * n].reshape(r, n, -1)
     with jax.named_scope("consensus_vote_many"):
-        return jnp.stack(
-            [
-                fused_cosine_vote(emb[i], temperature=temperature)
-                for i in range(r)
-            ]
+        nrm = l2_normalize(emb)
+        sims = jnp.einsum(
+            "rnd,rmd->rnm",
+            nrm,
+            nrm,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
+        eye = jnp.eye(n, dtype=sims.dtype)
+        off_diag = sims - eye[None, :, :] * sims
+        mean_sim = jnp.sum(off_diag, axis=-1) / jnp.maximum(n - 1, 1)
+        return jax.nn.softmax(mean_sim / temperature, axis=-1)
 
 
 @partial(
@@ -263,18 +271,38 @@ class TpuEmbedder:
         self, ids: np.ndarray, mask: np.ndarray, temperature: float = 0.05
     ):
         """ids/mask[R, N, S] (R concurrent requests) -> confidence[R, N] in
-        ONE device dispatch (dynamic batching for the serving loop)."""
+        ONE device dispatch (dynamic batching for the serving loop).
+
+        R buckets to the next power of two before the jit: the batcher's
+        group size varies with load, and an exact-R specialization would
+        recompile the full encoder per distinct concurrency level (tens
+        of seconds each for bge-large).  Pad request slots attend to one
+        [PAD] token; their confidences are sliced off."""
         r, n, s = ids.shape
-        flat_ids, flat_mask = self._pad_rows(
-            ids.reshape(r * n, s), mask.reshape(r * n, s)
-        )
+        r_bucket = 1
+        while r_bucket < r:
+            r_bucket *= 2
+        if r_bucket != r:
+            pad = (r_bucket - r) * n
+            ids = np.concatenate(
+                [ids.reshape(r * n, s), np.zeros((pad, s), ids.dtype)]
+            )
+            mask = np.concatenate(
+                [mask.reshape(r * n, s), np.zeros((pad, s), mask.dtype)]
+            )
+            mask[r * n :, 0] = 1
+        else:
+            ids = ids.reshape(r * n, s)
+            mask = mask.reshape(r * n, s)
+        flat_ids, flat_mask = self._pad_rows(ids, mask)
         dev_ids, dev_mask = self.put_batch(
             jnp.asarray(flat_ids), jnp.asarray(flat_mask)
         )
-        return _embed_and_vote_many(
-            self.params, dev_ids, dev_mask, r, n, self.config, self.pooling,
-            temperature,
+        conf = _embed_and_vote_many(
+            self.params, dev_ids, dev_mask, r_bucket, n, self.config,
+            self.pooling, temperature,
         )
+        return conf[:r]
 
     def token_count(self, texts: list, max_tokens: Optional[int] = None) -> int:
         _, mask = self.tokenize(texts, max_tokens)

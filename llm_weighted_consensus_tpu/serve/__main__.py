@@ -410,17 +410,23 @@ class _ArchivingClient:
         completed = False
         try:
             async for chunk in stream:
-                # error items (e.g. ChatError frames the chat stream
-                # yields mid-stream) pass through to the client but
-                # poison the fold: an errored stream is not a complete
-                # completion, so nothing is archived — error isolation
-                # is identical with and without the tee
-                if foldable and isinstance(chunk, Exception):
-                    foldable = False
-                elif foldable and aggregate is None:
-                    aggregate = chunk.clone()
-                elif foldable:
-                    aggregate.push(chunk)
+                # the fold is a side-channel and must NEVER break the
+                # client-facing stream: error items (e.g. ChatError
+                # frames the chat stream yields mid-stream) and any
+                # clone/push failure poison the fold — nothing gets
+                # archived — while every chunk still reaches the client.
+                # Error isolation is identical with and without the tee.
+                if foldable:
+                    try:
+                        if isinstance(chunk, Exception):
+                            foldable = False
+                        elif aggregate is None:
+                            aggregate = chunk.clone()
+                        else:
+                            aggregate.push(chunk)
+                    except Exception:
+                        foldable = False
+                        aggregate = None
                 yield chunk
             completed = True
         finally:
@@ -431,7 +437,16 @@ class _ArchivingClient:
             if aclose is not None:
                 await aclose()
         if completed and foldable and aggregate is not None:
-            self._put(self._stream_fold(aggregate), params)
+            try:
+                self._put(self._stream_fold(aggregate), params)
+            except Exception:
+                import logging
+
+                logging.getLogger("lwc.serve").warning(
+                    "streamed completion could not be archived "
+                    "(fold/store failure); the response was served intact",
+                    exc_info=True,
+                )
 
 
 def build_service(config: Config, fake_upstream: bool = False):
@@ -489,6 +504,8 @@ def build_service(config: Config, fake_upstream: bool = False):
             metrics,
             window_ms=config.batch_window_ms,
             max_batch=config.batch_max,
+            pipeline_depth=config.batch_pipeline,
+            max_rows=config.batch_max_rows,
         )
     weight_fetchers = WeightFetchers()
     tables = None

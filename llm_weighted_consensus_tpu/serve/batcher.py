@@ -22,11 +22,15 @@ Three work kinds are batched:
                     streams' updates run as one vmapped dispatch
                     (``stream_vote_update_many``).
 
-A single dispatch thread serializes device calls, which is what makes the
-window mostly free: while one batch is on device, new arrivals queue and are
-dispatched together the moment it returns.  Utilization (queue depth, busy
-fraction, items-per-dispatch) is exposed through the metrics provider hook
-so the window/batch knobs are tunable from ``GET /metrics``.
+Dispatches are PIPELINED to ``pipeline_depth`` in flight (default 2): the
+host side of dispatch k+1 (tokenize + buffer staging, a significant slice
+of wall time at large batches) overlaps dispatch k's device execution —
+the same overlap bench.py's async-dispatch throughput loop exploits.  XLA
+orders the device work on its stream, so results are unaffected; arrivals
+while every slot is busy queue and ride the next group.  Utilization
+(queue depth, busy fraction, items-per-dispatch) is exposed through the
+metrics provider hook so the window/batch knobs are tunable from
+``GET /metrics``.
 """
 
 from __future__ import annotations
@@ -66,19 +70,35 @@ class DeviceBatcher:
         *,
         window_ms: float = 3.0,
         max_batch: int = 64,
+        pipeline_depth: int = 2,
+        max_rows: int = 512,
     ) -> None:
         self.embedder = embedder
         self.metrics = metrics
         self.window_ms = float(window_ms)
         self.max_batch = int(max_batch)
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        # rows (encoder batch entries) per dispatch: a synchronized burst
+        # of K requests otherwise forms ONE giant group per drain round,
+        # which the pipeline cannot overlap (the next round's group only
+        # forms after this one's responses restart the closed loop);
+        # chunking by rows turns a burst into pipeline_depth-overlappable
+        # sub-dispatches sized for good MXU utilization
+        self.max_rows = max(1, int(max_rows))
         self._pending: list = []
         self._flusher: Optional[asyncio.Task] = None
+        self._sem: Optional[asyncio.Semaphore] = None
+        # set by _submit so a parked _drain starts new work immediately
+        # instead of waiting out an in-flight dispatch
+        self._wake: Optional[asyncio.Event] = None
         self._executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="lwc-device"
+            max_workers=self.pipeline_depth,
+            thread_name_prefix="lwc-device",
         )
         # recent device-dispatch intervals, for the busy-fraction gauge
         self._busy: deque = deque(maxlen=1024)
-        self._inflight_since: Optional[float] = None
+        # start times of dispatches currently in flight (pipelined: >1)
+        self._inflight: dict = {}
         self._started = time.perf_counter()
         self._dispatches = 0
         self._items = 0
@@ -128,8 +148,8 @@ class DeviceBatcher:
             max(0.0, min(end, now) - max(start, lo))
             for start, end in self._busy
         )
-        if self._inflight_since is not None:
-            busy += now - max(self._inflight_since, lo)
+        for start in self._inflight.values():
+            busy += now - max(start, lo)
         span = max(min(window_sec, now - self._started), 1e-9)
         return {
             "queue_depth": len(self._pending),
@@ -153,38 +173,73 @@ class DeviceBatcher:
         self._pending.append(_Item(kind, key, payload, future))
         if self._flusher is None or self._flusher.done():
             self._flusher = loop.create_task(self._drain())
+        elif self._wake is not None:
+            self._wake.set()  # unpark a flusher waiting on in-flight work
         return await future
 
     async def _drain(self) -> None:
         loop = asyncio.get_running_loop()
+        if self._sem is None:
+            self._sem = asyncio.Semaphore(self.pipeline_depth)
+            self._wake = asyncio.Event()
         if self.window_ms > 0:
             # the accumulation window: lone arrivals wait this long for
             # company; arrivals during a dispatch skip it (they already
             # waited behind the device)
             await asyncio.sleep(self.window_ms / 1000.0)
-        while self._pending:
-            batch, self._pending = self._pending, []
-            for group in self._group(batch):
-                t0 = time.perf_counter()
-                self._inflight_since = t0
+        inflight: set = set()
+        while self._pending or inflight:
+            if self._pending:
+                batch, self._pending = self._pending, []
+                for group in self._group(batch):
+                    # bounded pipelining: block here (arrivals keep
+                    # appending to _pending) until a dispatch slot frees
+                    await self._sem.acquire()
+                    task = loop.create_task(self._run_group(loop, group))
+                    inflight.add(task)
+                    task.add_done_callback(inflight.discard)
+            else:
+                # park until a dispatch finishes OR a new item arrives
+                # (_submit sets the wake event) — a free pipeline slot
+                # must start staging new work immediately, not wait out
+                # the in-flight device call
+                self._wake.clear()
+                waker = loop.create_task(self._wake.wait())
                 try:
-                    results = await loop.run_in_executor(
-                        self._executor, self._dispatch, group
+                    await asyncio.wait(
+                        {waker, *inflight},
+                        return_when=asyncio.FIRST_COMPLETED,
                     )
-                except Exception as e:
-                    for item in group:
-                        if not item.future.done():
-                            item.future.set_exception(e)
-                    self._observe(group, t0, error=True)
-                else:
-                    for item, result in zip(group, results):
-                        if not item.future.done():
-                            item.future.set_result(result)
-                    self._observe(group, t0, error=False)
+                finally:
+                    waker.cancel()
 
-    def _observe(self, group, t0, *, error: bool) -> None:
+    async def _run_group(self, loop, group) -> None:
+        t0 = time.perf_counter()
+        token = object()
+        self._inflight[token] = t0
+        try:
+            results = await loop.run_in_executor(
+                self._executor, self._dispatch, group
+            )
+        except Exception as e:
+            for item in group:
+                if not item.future.done():
+                    item.future.set_exception(e)
+            self._observe(group, t0, token, error=True)
+        else:
+            for item, result in zip(group, results):
+                if not item.future.done():
+                    item.future.set_result(result)
+            self._observe(group, t0, token, error=False)
+        finally:
+            self._sem.release()
+
+    def _observe(self, group, t0, token, *, error: bool) -> None:
         end = time.perf_counter()
-        self._inflight_since = None
+        self._inflight.pop(token, None)
+        # overlapping pipelined intervals can double-count; the busy
+        # fraction gauge clamps at 1.0, which is the honest reading of
+        # "the device path has work in flight"
         self._busy.append((t0, end))
         self._dispatches += 1
         self._items += len(group)
@@ -195,9 +250,17 @@ class DeviceBatcher:
                 error=error,
             )
 
+    @staticmethod
+    def _rows(item) -> int:
+        """Encoder rows one item contributes to its dispatch."""
+        if item.kind in ("embed", "consensus"):
+            return max(1, len(item.payload[0]))
+        return 1  # stream: one new candidate per update
+
     def _group(self, batch: list):
         """Compatible-work groups, arrival order preserved, each at most
-        ``max_batch`` items."""
+        ``max_batch`` items AND ``max_rows`` encoder rows (so one burst
+        splits into pipeline-overlappable dispatches)."""
         groups: dict = {}
         order = []
         for item in batch:
@@ -207,8 +270,20 @@ class DeviceBatcher:
             groups[item.key].append(item)
         for key in order:
             items = groups[key]
-            for i in range(0, len(items), self.max_batch):
-                yield items[i : i + self.max_batch]
+            group: list = []
+            rows = 0
+            for item in items:
+                r = self._rows(item)
+                if group and (
+                    len(group) >= self.max_batch
+                    or rows + r > self.max_rows
+                ):
+                    yield group
+                    group, rows = [], 0
+                group.append(item)
+                rows += r
+            if group:
+                yield group
 
     # -- dispatch implementations (device thread) ------------------------------
 

@@ -67,6 +67,12 @@ TPU additions:
   in-flight dispatches.  Default 3.
 * ``BATCH_MAX`` — max items per fused device dispatch (oversized groups
   chunk).  Default 64.
+* ``BATCH_PIPELINE`` — device dispatches allowed in flight concurrently
+  (the host side of batch k+1 overlaps batch k's device execution).
+  Default 2; 1 = fully serialized.
+* ``BATCH_MAX_ROWS`` — encoder rows per fused dispatch; a synchronized
+  burst of requests chunks into this many rows per dispatch so the
+  pipeline has pieces to overlap.  Default 512.
 """
 
 from __future__ import annotations
@@ -148,6 +154,11 @@ class Config:
     tables_path: Optional[str] = None
     batch_window_ms: float = 3.0
     batch_max: int = 64
+    # concurrent device dispatches in flight (host staging of batch k+1
+    # overlaps device compute of batch k)
+    batch_pipeline: int = 2
+    # encoder rows per dispatch (bursts chunk into overlappable pieces)
+    batch_max_rows: int = 512
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -221,6 +232,8 @@ class Config:
             tables_path=env.get("TABLES_PATH"),
             batch_window_ms=get_f("BATCH_WINDOW_MS", 3.0),
             batch_max=int(env.get("BATCH_MAX", 64)),
+            batch_pipeline=max(1, int(env.get("BATCH_PIPELINE", 2))),
+            batch_max_rows=max(1, int(env.get("BATCH_MAX_ROWS", 512))),
         )
 
     def backoff_policy(self):

@@ -304,6 +304,9 @@ def build_app(
         app.router.add_post(
             "/embeddings", _embeddings_handler(embedder, metrics, batcher)
         )
+        app.router.add_post(
+            "/consensus", _consensus_handler(embedder, metrics, batcher)
+        )
 
     async def healthz(request):
         return web.json_response({"ok": True})
@@ -318,6 +321,90 @@ def build_app(
         app.router.add_post("/profile/start", start)
         app.router.add_post("/profile/stop", stop)
     return app
+
+
+def _consensus_handler(embedder, metrics=None, batcher=None):
+    """POST /consensus: the device self-consistency scorer as a direct
+    service — N candidate texts in, the cosine consensus confidence
+    distribution out (one fused embed+vote dispatch; concurrent requests
+    coalesce via the micro-batcher).  This is the HTTP analog of the
+    headline bench path (bench.py N=64 self-consistency) — no reference
+    analog (its scoring always goes through judge LLMs; SURVEY §2.6).
+
+    Body: {"input": [texts...], "temperature"?: float}.  Response:
+    {"model", "confidence": [...], "usage": {prompt_tokens, total_tokens}}.
+    """
+    import asyncio
+
+    async def handler(request: web.Request):
+        try:
+            body = jsonutil.loads(await request.text())
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            texts = body.get("input")
+            if (
+                not isinstance(texts, list)
+                or len(texts) < 2
+                or not all(isinstance(t, str) for t in texts)
+            ):
+                raise ValueError(
+                    "`input` must be a list of >= 2 candidate strings"
+                )
+            temperature = float(body.get("temperature", 0.05))
+            import math
+
+            if not math.isfinite(temperature) or temperature <= 0:
+                raise ValueError(
+                    "`temperature` must be a finite positive number"
+                )
+        except web.HTTPException:
+            raise  # e.g. 413 body-too-large must keep its status
+        except Exception as e:  # parse phase is side-effect free
+            return web.Response(
+                status=400,
+                text=jsonutil.dumps({"code": 400, "message": str(e)}),
+                content_type="application/json",
+            )
+        try:
+            if batcher is not None:
+                conf = await batcher.consensus(texts, temperature)
+            else:
+                t0 = _time.perf_counter()
+                conf = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    lambda: embedder.consensus_confidence(
+                        texts, temperature=temperature
+                    ),
+                )
+                if metrics is not None:
+                    metrics.observe(
+                        "device:consensus",
+                        (_time.perf_counter() - t0) * 1e3,
+                    )
+        except Exception as e:
+            return _error_response(e)
+        import numpy as np
+
+        conf = np.asarray(conf)
+        # token count re-tokenizes on host (~ms, native fast path) — the
+        # dispatch path doesn't return counts, and usage is part of this
+        # framework's in-band accounting contract (SURVEY §5 metrics row)
+        tokens = embedder.token_count(texts)
+        return web.Response(
+            text=jsonutil.dumps(
+                {
+                    "model": embedder.model_name,
+                    "confidence": [float(c) for c in conf],
+                    "usage": {
+                        "prompt_tokens": tokens,
+                        "total_tokens": tokens,
+                    },
+                }
+            ),
+            content_type="application/json",
+        )
+
+    return handler
 
 
 def _embeddings_handler(embedder, metrics=None, batcher=None):

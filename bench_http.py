@@ -20,7 +20,7 @@ inside the timed path.  Three served endpoints:
 
 Prints ONE JSON line per endpoint: {"endpoint", "value", "unit",
 "p50_ms", ...}.  Flags: --model (default bge-large-en on TPU, test-tiny
-elsewhere), --n, --seq, --requests, --concurrency, --quick.
+elsewhere), --n, --requests, --concurrency, --quick.
 """
 
 from __future__ import annotations
@@ -131,7 +131,7 @@ async def _drive(session, url, bodies, concurrency, warmup_bursts=2):
 
 
 async def bench_consensus_endpoint(
-    session, base, embedder, n, seq, requests, concurrency
+    session, base, embedder, n, requests, concurrency
 ):
     """Served /consensus vs the direct-call twin on identical inputs."""
     reqs = make_requests(requests, n)
@@ -142,7 +142,6 @@ async def bench_consensus_endpoint(
     # batcher can produce under this concurrency, plus the r=1 path
     loop = asyncio.get_running_loop()
     ids, mask = embedder.tokenize(reqs[0])
-    seq = ids.shape[1]
     r_bucket = 1
     while True:
         r_eff = min(r_bucket, concurrency)
@@ -312,7 +311,6 @@ async def main_async(args) -> None:
                     base,
                     embedder,
                     args.n,
-                    args.seq,
                     args.requests,
                     args.concurrency,
                 )
@@ -339,7 +337,6 @@ def main() -> None:
         default_model = "test-tiny"
     parser.add_argument("--model", default=default_model)
     parser.add_argument("--n", type=int, default=64)
-    parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--requests", type=int, default=100)
     parser.add_argument("--concurrency", type=int, default=16)
     parser.add_argument("--window-ms", type=float, default=3.0)

@@ -24,29 +24,61 @@ from .configs import PRESETS, BertConfig
 from .tokenizer import BaseTokenizer, load_tokenizer
 
 
+DEFAULT_VOTE_TEMPERATURE = 0.05
+
+
+def _dyn_cosine_vote(emb, temperature):
+    """cosine_consensus_vote numerics with a TRACED temperature — user-
+    supplied temperatures must not be jit-static, or every distinct value
+    compiles a fresh encoder program (a recompile-DoS through the
+    /consensus endpoint)."""
+    from ..ops.similarity import l2_normalize
+
+    nrm = l2_normalize(emb)
+    sims = jnp.einsum(
+        "nd,md->nm",
+        nrm,
+        nrm,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    n = sims.shape[0]
+    off_diag = sims - jnp.eye(n, dtype=sims.dtype) * sims
+    mean_sim = jnp.sum(off_diag, axis=-1) / jnp.maximum(n - 1, 1)
+    return jax.nn.softmax(mean_sim / temperature)
+
+
 @partial(
-    jax.jit, static_argnames=("n", "config", "pooling", "temperature")
+    jax.jit, static_argnames=("n", "config", "pooling", "use_fused")
 )
-def _embed_and_vote(params, ids, mask, n, config, pooling, temperature):
+def _embed_and_vote(
+    params, ids, mask, temperature, n, config, pooling, use_fused
+):
     """Single-dispatch self-consistency: encoder forward + cosine consensus
     vote fused under one jit so nothing round-trips the host between them
-    (the serving hot path: one upload, one tiny download).  The vote runs
-    in the fused Pallas kernel (VMEM-resident normalize+cosine+softmax);
-    ``fused_cosine_vote`` itself falls back to the jnp composition beyond
-    its single-block budget.  Rows past ``n`` are dp-alignment padding
-    (sliced off before the vote so they cannot perturb the softmax)."""
+    (the serving hot path: one upload, one tiny download).  At the default
+    temperature the vote runs in the fused Pallas kernel (VMEM-resident
+    normalize+cosine+softmax, which bakes the temperature in); any other
+    temperature takes the jnp composition with temperature TRACED, so
+    user-supplied values never trigger recompiles.  Rows past ``n`` are
+    dp-alignment padding (sliced off before the vote so they cannot
+    perturb the softmax)."""
     from ..ops.kernels import fused_cosine_vote
 
     emb = bert.embed(params, ids, mask, config, pooling=pooling)
     with jax.named_scope("consensus_vote"):
-        return fused_cosine_vote(emb[:n], temperature=temperature)
+        if use_fused:
+            return fused_cosine_vote(
+                emb[:n], temperature=DEFAULT_VOTE_TEMPERATURE
+            )
+        return _dyn_cosine_vote(emb[:n], temperature)
 
 
 @partial(
-    jax.jit, static_argnames=("r", "n", "config", "pooling", "temperature")
+    jax.jit, static_argnames=("r", "n", "config", "pooling")
 )
 def _embed_and_vote_many(
-    params, ids, mask, r, n, config, pooling, temperature
+    params, ids, mask, temperature, r, n, config, pooling
 ):
     """Batched self-consistency: ids/mask[>=R*N, S] -> confidence[R, N].
 
@@ -263,8 +295,16 @@ class TpuEmbedder:
         ids, mask = self._pad_rows(ids, mask)
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
         return _embed_and_vote(
-            self.params, dev_ids, dev_mask, n, self.config, self.pooling,
-            temperature,
+            self.params,
+            dev_ids,
+            dev_mask,
+            float(temperature),
+            n,
+            self.config,
+            self.pooling,
+            # the Pallas fast path bakes its temperature in; any other
+            # value rides the traced-jnp vote (no per-value recompiles)
+            use_fused=float(temperature) == DEFAULT_VOTE_TEMPERATURE,
         )
 
     def consensus_confidence_tokens_many(
@@ -299,8 +339,8 @@ class TpuEmbedder:
             jnp.asarray(flat_ids), jnp.asarray(flat_mask)
         )
         conf = _embed_and_vote_many(
-            self.params, dev_ids, dev_mask, r_bucket, n, self.config,
-            self.pooling, temperature,
+            self.params, dev_ids, dev_mask, float(temperature), r_bucket,
+            n, self.config, self.pooling,
         )
         return conf[:r]
 

@@ -115,9 +115,12 @@ class DeviceBatcher:
         )
 
     async def consensus(self, texts: list, temperature: float = 0.05):
-        """N candidate texts -> confidence[N] (embed + cosine consensus vote
-        in one fused dispatch).  Batches with same-N same-temperature
-        requests via ``consensus_confidence_tokens_many``."""
+        """N candidate texts -> (confidence[N], token_count): embed +
+        cosine consensus vote in one fused dispatch, with the prompt
+        token count from the SAME tokenization (callers must not
+        re-tokenize on the event loop for usage accounting).  Batches
+        with same-N same-temperature requests via
+        ``consensus_confidence_tokens_many``."""
         return await self._submit(
             "consensus",
             ("consensus", len(texts), float(temperature)),
@@ -317,13 +320,13 @@ class DeviceBatcher:
         texts0, temperature = group[0].payload
         n = len(texts0)
         if len(group) == 1:
-            return [
-                np.asarray(
-                    self.embedder.consensus_confidence(
-                        texts0, temperature=temperature
-                    )
+            ids, mask = self.embedder.tokenize(texts0)
+            conf = np.asarray(
+                self.embedder.consensus_confidence_tokens(
+                    ids, mask, temperature
                 )
-            ]
+            )
+            return [(conf, int(mask.sum()))]
         all_texts = [t for item in group for t in item.payload[0]]
         ids, mask = self.embedder.tokenize(all_texts)
         r = len(group)
@@ -332,7 +335,8 @@ class DeviceBatcher:
                 ids.reshape(r, n, -1), mask.reshape(r, n, -1), temperature
             )
         )
-        return [conf[i] for i in range(r)]
+        tokens = mask.reshape(r, n, -1).sum(axis=(1, 2))
+        return [(conf[i], int(tokens[i])) for i in range(r)]
 
     def _dispatch_stream(self, group: list) -> list:
         if len(group) == 1:

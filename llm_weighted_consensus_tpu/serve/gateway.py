@@ -173,7 +173,7 @@ def _multichat_unary(multichat_client, embedder, batcher):
                 texts.append(content)
         if len(texts) >= 2:
             try:
-                conf = await batcher.consensus(texts)
+                conf, _tokens = await batcher.consensus(texts)
             except Exception:
                 # the consensus is an overlay on the multichat result: an
                 # embedder failure degrades to plain multichat (no
@@ -323,6 +323,13 @@ def build_app(
     return app
 
 
+# /consensus request-size ceiling: bounds the device batch a single
+# request can demand, and — because the candidate count is a jit-static
+# shape — bounds the total set of compiled specializations a client can
+# force (temperature is traced, so it can never force one)
+MAX_CONSENSUS_CANDIDATES = 256
+
+
 def _consensus_handler(embedder, metrics=None, batcher=None):
     """POST /consensus: the device self-consistency scorer as a direct
     service — N candidate texts in, the cosine consensus confidence
@@ -350,6 +357,11 @@ def _consensus_handler(embedder, metrics=None, batcher=None):
                 raise ValueError(
                     "`input` must be a list of >= 2 candidate strings"
                 )
+            if len(texts) > MAX_CONSENSUS_CANDIDATES:
+                raise ValueError(
+                    f"`input` accepts at most {MAX_CONSENSUS_CANDIDATES} "
+                    "candidates per request"
+                )
             temperature = float(body.get("temperature", 0.05))
             import math
 
@@ -367,14 +379,23 @@ def _consensus_handler(embedder, metrics=None, batcher=None):
             )
         try:
             if batcher is not None:
-                conf = await batcher.consensus(texts, temperature)
+                conf, tokens = await batcher.consensus(texts, temperature)
             else:
+
+                def run():
+                    ids, mask = embedder.tokenize(texts)
+                    return (
+                        embedder.consensus_confidence_tokens(
+                            ids, mask, temperature
+                        ),
+                        int(mask.sum()),
+                    )
+
                 t0 = _time.perf_counter()
-                conf = await asyncio.get_running_loop().run_in_executor(
-                    None,
-                    lambda: embedder.consensus_confidence(
-                        texts, temperature=temperature
-                    ),
+                conf, tokens = (
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, run
+                    )
                 )
                 if metrics is not None:
                     metrics.observe(
@@ -386,10 +407,6 @@ def _consensus_handler(embedder, metrics=None, batcher=None):
         import numpy as np
 
         conf = np.asarray(conf)
-        # token count re-tokenizes on host (~ms, native fast path) — the
-        # dispatch path doesn't return counts, and usage is part of this
-        # framework's in-band accounting contract (SURVEY §5 metrics row)
-        tokens = embedder.token_count(texts)
         return web.Response(
             text=jsonutil.dumps(
                 {

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Regenerate the committed ``bge_micro`` golden-checkpoint fixture.
+
+The image has zero egress and an empty HF cache, so a *trained* bge
+checkpoint cannot be committed (VERDICT r2 item 10 asked for a truncated
+real one — impossible offline).  What CAN be pinned on every run is the
+full real-checkpoint *pipeline*: an HF-snapshot-layout directory
+(config.json + model.safetensors + vocab.txt) written by transformers'
+own ``save_pretrained``, loaded by our ``loading.load_params`` +
+tokenized by our WordPiece, and checked numerically against
+``transformers.BertModel`` running the same files — the independent
+implementation real checkpoints were trained with.  Weight values are
+seeded-random; the parity claim is about numerics and file-format
+handling, which is exactly what the skipped golden test existed to cover.
+
+Run from the repo root: ``python tests/fixtures/make_bge_micro.py``
+(deterministic given the pinned torch seed; artifacts are committed, so
+this script is provenance, not a build step).
+"""
+
+import os
+
+import torch
+import transformers
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "bge_micro")
+
+WORDS = [
+    "represent", "this", "sentence", "weighted", "consensus", "on", "tpu",
+    "the", "answer", "is", "a", "an", "of", "and", "to", "in", "for",
+    "candidate", "judge", "vote", "model", "panel", "confidence", "score",
+    "embedding", "cosine", "softmax", "device", "mesh", "host", "stream",
+]
+
+
+def build_vocab():
+    alphanum = list("abcdefghijklmnopqrstuvwxyz0123456789")
+    tokens = (
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "."]
+        + WORDS
+        + alphanum
+        + ["##" + c for c in alphanum]
+    )
+    return list(dict.fromkeys(tokens))
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    vocab = build_vocab()
+    with open(os.path.join(OUT, "vocab.txt"), "w", encoding="utf-8") as f:
+        f.write("\n".join(vocab) + "\n")
+    torch.manual_seed(20260730)
+    config = transformers.BertConfig(
+        vocab_size=len(vocab),
+        hidden_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=192,
+        max_position_embeddings=128,
+        type_vocab_size=2,
+        layer_norm_eps=1e-12,
+    )
+    model = transformers.BertModel(config, add_pooling_layer=False)
+    model.eval()
+    model.save_pretrained(OUT, safe_serialization=True)
+    print(f"wrote {OUT}: vocab={len(vocab)} files={sorted(os.listdir(OUT))}")
+
+
+if __name__ == "__main__":
+    main()

@@ -84,12 +84,14 @@ def test_consensus_batches_same_shape(embedder):
             batcher.consensus(texts_a),
         )
 
-    conf_a, conf_b, conf_a2 = go(run())
+    (conf_a, tok_a), (conf_b, tok_b), (conf_a2, _) = go(run())
     ref_a = np.asarray(embedder.consensus_confidence(texts_a))
     ref_b = np.asarray(embedder.consensus_confidence(texts_b))
     np.testing.assert_allclose(conf_a, ref_a, atol=1e-5)
     np.testing.assert_allclose(conf_b, ref_b, atol=1e-5)
     np.testing.assert_allclose(conf_a2, ref_a, atol=1e-5)
+    assert tok_a == embedder.token_count(texts_a)
+    assert tok_b == embedder.token_count(texts_b)
     assert metrics.snapshot()["series"]["device:batch:consensus"]["count"] == 1
 
 
@@ -103,8 +105,9 @@ def test_consensus_mixed_shapes_split_groups(embedder):
             batcher.consensus(["d", "e"]),  # different N: its own group
         )
 
-    c3, c2 = go(run())
+    (c3, t3), (c2, t2) = go(run())
     assert c3.shape == (3,) and c2.shape == (2,)
+    assert t3 > 0 and t2 > 0
     assert metrics.snapshot()["series"]["device:batch:consensus"]["count"] == 2
 
 

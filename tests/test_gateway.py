@@ -551,12 +551,14 @@ def test_consensus_endpoint_batches_concurrent_requests():
         # warm the r=1 and r-bucket compiles so the timed coalesce isn't
         # serialized by compilation
         await one(0)
+        before = app[METRICS_KEY].snapshot()["device_batcher"]["dispatches"]
         results = await asyncio.gather(*(one(i) for i in range(8)))
         assert all(len(r["confidence"]) == 3 for r in results)
-        snapshot = app[METRICS_KEY].snapshot()
-        series = snapshot.get("series", snapshot)
-        batched = [k for k in series if "device:batch:consensus" in k]
-        assert batched, f"no batched consensus series in {list(series)}"
+        util = app[METRICS_KEY].snapshot()["device_batcher"]
+        dispatched = util["dispatches"] - before
+        # the actual coalescing gate: 8 concurrent requests must share
+        # dispatches, not get one each
+        assert 0 < dispatched < 8, util
 
     go(with_client(app, run))
 

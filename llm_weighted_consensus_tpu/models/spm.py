@@ -217,6 +217,7 @@ class UnigramTokenizer(BaseTokenizer):
         self,
         pieces: List[Tuple[str, float, int]],
         scheme: str = "xlmr",
+        use_native: bool = True,
     ):
         if scheme not in ("xlmr", "deberta"):
             raise ValueError(
@@ -256,6 +257,10 @@ class UnigramTokenizer(BaseTokenizer):
             self.unk_id = by_name.get("[UNK]", unk_spm)
             self._unk_spm = self.unk_id
             self.vocab_size = len(pieces)
+        # C++ Viterbi fast path for pure-ASCII texts (native/unigram.cpp;
+        # NFKC is the identity there) — parity-tested, Python fallback
+        # owns all real Unicode
+        self._native = _native_unigram(self) if use_native else None
 
     @classmethod
     def from_model_bytes(
@@ -290,6 +295,10 @@ class UnigramTokenizer(BaseTokenizer):
         return spm_id + self._offset
 
     def _encode(self, text: str, max_length: int):
+        if self._native is not None and text.isascii():
+            out = self._native.encode(text, max_length)
+            if out is not None:
+                return out
         ids = [self.cls_id]
         done = False
         for word in normalize(text).split():
@@ -303,6 +312,45 @@ class UnigramTokenizer(BaseTokenizer):
         ids = ids[: max_length - 1]
         ids.append(self.sep_id)
         return ids
+
+
+def _native_unigram(tok: "UnigramTokenizer"):
+    """A native bridge for this tokenizer, or None when the native
+    library is unavailable.  Blob: header "cls sep unk offset unk_spm",
+    then one "score\\tmatchable\\tpiece" line per piece in spm-id order
+    (see native/unigram.cpp).
+
+    Matchability mirrors the Python Viterbi exactly — NORMAL and
+    USER_DEFINED pieces, INCLUDING the one at the unk index (its matches
+    remap to unk on emit, which is what ``unk_spm`` in the header is
+    for).  Pieces containing framing bytes (newline/tab) can never match
+    an ASCII chunk anyway (whitespace splits before segmentation), so
+    they are written unmatchable with an EMPTY text field — embedding
+    their raw bytes would corrupt the line framing and silently shift
+    every later piece id."""
+    try:
+        from ..utils.native import load_library
+        from .tokenizer import NativeTokenizerBridge
+
+        lib = load_library()
+        if lib is None:
+            return None
+        lines = [
+            f"{tok.cls_id} {tok.sep_id} {tok.unk_id} {tok._offset} "
+            f"{tok._unk_spm}"
+        ]
+        for piece, score, ptype in tok.pieces:
+            matchable = ptype in (NORMAL, USER_DEFINED) and not any(
+                c in piece for c in "\n\r\t"
+            )
+            lines.append(
+                f"{score!r}\t{1 if matchable else 0}\t"
+                f"{piece if matchable else ''}"
+            )
+        blob = ("\n".join(lines) + "\n").encode("utf-8")
+        return NativeTokenizerBridge(lib, "spm", blob)
+    except Exception:
+        return None
 
 
 # filenames probed (in order) next to checkpoint weights

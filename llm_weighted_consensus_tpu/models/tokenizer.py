@@ -154,17 +154,22 @@ class WordPieceTokenizer(BaseTokenizer):
         return ids
 
 
-class _NativeWordPiece:
-    """ctypes bridge to native/wordpiece.cpp (ASCII fast path)."""
+class NativeTokenizerBridge:
+    """ctypes bridge to one native tokenizer (``<prefix>_new`` /
+    ``<prefix>_encode`` / ``<prefix>_free`` in liblwc_native.so) — shared
+    by the WordPiece ('wp') and unigram ('spm') fast paths, which expose
+    the identical C ABI."""
 
-    def __init__(self, lib, vocab_blob: bytes):
+    def __init__(self, lib, prefix: str, blob: bytes):
         import ctypes
 
-        lib.wp_new.restype = ctypes.c_void_p
-        lib.wp_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-        lib.wp_free.argtypes = [ctypes.c_void_p]
-        lib.wp_encode.restype = ctypes.c_int64
-        lib.wp_encode.argtypes = [
+        new = getattr(lib, f"{prefix}_new")
+        new.restype = ctypes.c_void_p
+        new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        getattr(lib, f"{prefix}_free").argtypes = [ctypes.c_void_p]
+        encode = getattr(lib, f"{prefix}_encode")
+        encode.restype = ctypes.c_int64
+        encode.argtypes = [
             ctypes.c_void_p,
             ctypes.c_char_p,
             ctypes.c_size_t,
@@ -172,18 +177,19 @@ class _NativeWordPiece:
             ctypes.POINTER(ctypes.c_int32),
         ]
         self._ctypes = ctypes
-        self._lib = lib
-        self._handle = lib.wp_new(vocab_blob, len(vocab_blob))
+        self._encode = encode
+        self._free = getattr(lib, f"{prefix}_free")
+        self._handle = new(blob, len(blob))
         if not self._handle:
-            raise ValueError("native wordpiece rejected the vocab")
+            raise ValueError(f"native {prefix} tokenizer rejected the blob")
 
     def encode(self, text: str, max_length: int):
-        # fresh output buffer per call: wp_encode releases the GIL, and the
-        # gateway encodes on executor threads — a shared buffer would race
-        # under concurrent /embeddings requests
+        # fresh output buffer per call: the native encode releases the
+        # GIL, and the gateway encodes on executor threads — a shared
+        # buffer would race under concurrent requests
         buf = (self._ctypes.c_int32 * max_length)()
         raw = text.encode("ascii")
-        n = self._lib.wp_encode(self._handle, raw, len(raw), max_length, buf)
+        n = self._encode(self._handle, raw, len(raw), max_length, buf)
         if n < 0:
             return None
         return list(buf[: int(n)])
@@ -191,7 +197,7 @@ class _NativeWordPiece:
     def __del__(self):
         try:
             if self._handle:
-                self._lib.wp_free(self._handle)
+                self._free(self._handle)
                 self._handle = None
         except Exception:
             pass
@@ -225,7 +231,7 @@ def _native_wordpiece(vocab: dict):
             if special not in vocab:
                 return None
         blob = ("\n".join(lines) + "\n").encode("utf-8")
-        return _NativeWordPiece(lib, blob)
+        return NativeTokenizerBridge(lib, "wp", blob)
     except Exception:
         return None
 

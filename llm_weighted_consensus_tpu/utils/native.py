@@ -1,8 +1,8 @@
 """Loader for the framework's native (C++) runtime library.
 
 One shared library (``native/liblwc_native.so``) carries every native
-component — the SSE parser and the WordPiece tokenizer — compiled on first
-use from the sources in ``native/``.  The compile goes to a temp file then
+component — the SSE parser and the WordPiece and unigram/SentencePiece
+tokenizers — compiled on first use from the sources in ``native/``.  The compile goes to a temp file then
 ``os.replace`` so concurrent builders can't hand anyone a truncated .so
 (and processes that already mapped the old inode keep it).  Loading is
 blocking: call from sync startup code, never from the event loop.

@@ -316,3 +316,162 @@ def test_native_wordpiece_thread_safety(native_wp):
             )
         )
     assert got == expected
+
+
+# -- native unigram / SentencePiece (ASCII fast path) -------------------------
+
+
+def _spm(use_native, scheme="xlmr"):
+    from test_spm import XLMR_PIECES
+
+    from llm_weighted_consensus_tpu.models.spm import (
+        CONTROL,
+        NORMAL,
+        UNKNOWN,
+        UnigramTokenizer,
+    )
+
+    if scheme == "deberta":
+        pieces = [
+            ("[PAD]", 0.0, CONTROL),
+            ("[CLS]", 0.0, CONTROL),
+            ("[SEP]", 0.0, CONTROL),
+            ("[UNK]", 0.0, UNKNOWN),
+        ] + [(p, s, t) for p, s, t in XLMR_PIECES if t == NORMAL]
+    else:
+        pieces = XLMR_PIECES
+    return UnigramTokenizer(pieces, scheme=scheme, use_native=use_native)
+
+
+SPM_TEXTS = [
+    "hello world",
+    "ab abc bca cab",
+    "the tokenizers tokenize tokens",
+    "zzz unknown zz chars",
+    "mixed abz zab zzab",
+    "",
+    "a",
+    "hello " * 100,  # truncation
+    "tabs\tand\nnewlines hello",
+    "ctrl\x00chars\x1cjoin",  # dropped controls JOIN adjacent chars
+]
+
+
+@pytest.fixture(scope="module")
+def native_spm():
+    tok = _spm(use_native=True)
+    if tok._native is None:
+        pytest.skip("native unigram not buildable here")
+    return tok
+
+
+def test_native_unigram_matches_python(native_spm):
+    python = _spm(use_native=False)
+    for max_len in (8, 16, 64):
+        ids_n, mask_n = native_spm.encode_batch(SPM_TEXTS, max_len)
+        ids_p, mask_p = python.encode_batch(SPM_TEXTS, max_len)
+        assert ids_n.tolist() == ids_p.tolist(), max_len
+        assert mask_n.tolist() == mask_p.tolist()
+
+
+def test_native_unigram_deberta_scheme_parity():
+    native = _spm(use_native=True, scheme="deberta")
+    if native._native is None:
+        pytest.skip("native unigram not buildable here")
+    python = _spm(use_native=False, scheme="deberta")
+    ids_n, _ = native.encode_batch(SPM_TEXTS, 24)
+    ids_p, _ = python.encode_batch(SPM_TEXTS, 24)
+    assert ids_n.tolist() == ids_p.tolist()
+
+
+def test_native_unigram_random_ascii_parity(native_spm):
+    import random
+    import string
+
+    python = _spm(use_native=False)
+    rng = random.Random(5)
+    chars = "abchelowrdtknizs " + string.punctuation + "\t"
+    texts = [
+        "".join(rng.choice(chars) for _ in range(rng.randint(0, 120)))
+        for _ in range(300)
+    ]
+    ids_n, _ = native_spm.encode_batch(texts, 48)
+    ids_p, _ = python.encode_batch(texts, 48)
+    assert ids_n.tolist() == ids_p.tolist()
+
+
+def test_native_unigram_non_ascii_falls_back(native_spm):
+    python = _spm(use_native=False)
+    texts = ["héllo wörld", "ｈｅｌｌｏ fullwidth", "mixed ascii héllo"]
+    ids_n, _ = native_spm.encode_batch(texts, 16)
+    ids_p, _ = python.encode_batch(texts, 16)
+    assert ids_n.tolist() == ids_p.tolist()
+
+
+def test_native_unigram_thread_safety(native_spm):
+    from concurrent.futures import ThreadPoolExecutor
+    import random
+
+    python = _spm(use_native=False)
+    rng = random.Random(11)
+    words = ["hello", "world", "ab", "abc", "tokens", "zzq"]
+    texts = [
+        " ".join(rng.choice(words) for _ in range(rng.randint(1, 60)))
+        for _ in range(200)
+    ]
+    lengths = [8 + (i % 5) * 16 for i in range(len(texts))]
+    expected = [python._encode(t, n) for t, n in zip(texts, lengths)]
+    with ThreadPoolExecutor(8) as pool:
+        got = list(
+            pool.map(
+                lambda tn: native_spm._encode(tn[0], tn[1]),
+                zip(texts, lengths),
+            )
+        )
+    assert got == expected
+
+
+def test_native_unigram_newline_piece_does_not_shift_ids():
+    """A vocab piece containing a newline must not break the blob's line
+    framing (it would silently shift every later piece id)."""
+    from llm_weighted_consensus_tpu.models.spm import (
+        NORMAL,
+        UNKNOWN,
+        UnigramTokenizer,
+    )
+
+    pieces = [
+        ("<unk>", 0.0, UNKNOWN),
+        ("\n", -2.5, NORMAL),
+        ("▁hello", -1.0, NORMAL),
+        ("▁world", -1.2, NORMAL),
+    ]
+    native = UnigramTokenizer(pieces, scheme="xlmr", use_native=True)
+    if native._native is None:
+        pytest.skip("native unigram not buildable here")
+    python = UnigramTokenizer(pieces, scheme="xlmr", use_native=False)
+    ids_n, _ = native.encode_batch(["hello world"], 8)
+    ids_p, _ = python.encode_batch(["hello world"], 8)
+    assert ids_n.tolist() == ids_p.tolist()
+
+
+def test_native_unigram_normal_piece_at_unk_index_parity():
+    """When the unk index holds a NORMAL piece, it still participates in
+    segmentation (remapped to unk on emit), exactly like Python."""
+    from llm_weighted_consensus_tpu.models.spm import (
+        NORMAL,
+        UnigramTokenizer,
+    )
+
+    pieces = [
+        ("▁ab", -1.0, NORMAL),  # unk_spm defaults to 0: this piece
+        ("▁a", -5.0, NORMAL),
+        ("b", -5.0, NORMAL),
+    ]
+    native = UnigramTokenizer(pieces, scheme="xlmr", use_native=True)
+    if native._native is None:
+        pytest.skip("native unigram not buildable here")
+    python = UnigramTokenizer(pieces, scheme="xlmr", use_native=False)
+    ids_n, _ = native.encode_batch(["ab", "a b ab"], 8)
+    ids_p, _ = python.encode_batch(["ab", "a b ab"], 8)
+    assert ids_n.tolist() == ids_p.tolist()

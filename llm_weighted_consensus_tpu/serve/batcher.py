@@ -341,11 +341,13 @@ class DeviceBatcher:
     def _dispatch_stream(self, group: list) -> list:
         if len(group) == 1:
             text, buf, valid, position, temperature = group[0].payload
-            return [
-                self.embedder.stream_vote_update(
-                    text, buf, valid, position, temperature
-                )
-            ]
+            out_buf, out_valid, conf = self.embedder.stream_vote_update(
+                text, buf, valid, position, temperature
+            )
+            # fetch here, on the device thread — a device-resident conf
+            # would make the caller's np.asarray stall the event loop
+            # for a link round-trip per update
+            return [(out_buf, out_valid, np.asarray(conf))]
         texts = [item.payload[0] for item in group]
         bufs = [item.payload[1] for item in group]
         valids = [item.payload[2] for item in group]
@@ -354,6 +356,13 @@ class DeviceBatcher:
         out_bufs, out_valids, confs = self.embedder.stream_vote_update_many(
             texts, bufs, valids, positions, temperature
         )
+        # fetch ALL confidences in ONE transfer here: every stream
+        # np.asarray's its own confidence right after this returns, and
+        # R separate slice fetches would re-serialize the round-trips
+        # the batching just fused (R x link RTT per dispatch).  bufs /
+        # valids stay device-resident — nobody reads them on host.
+        confs_host = np.asarray(confs)
         return [
-            (out_bufs[i], out_valids[i], confs[i]) for i in range(len(group))
+            (out_bufs[i], out_valids[i], confs_host[i])
+            for i in range(len(group))
         ]

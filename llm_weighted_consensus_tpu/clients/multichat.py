@@ -317,9 +317,11 @@ class StreamingSelfConsistency:
     async def _embed_slots_batched(self, slots: list) -> None:
         """``_embed_slots`` through the serving micro-batcher: each update
         awaits its turn in a shared device dispatch, so R concurrent
-        streams' finished candidates ride one vmapped embed+revote."""
+        streams' finished candidates ride one vmapped embed+revote.  Only
+        the LAST slot's confidence is published, so intermediate updates
+        skip the host fetch (want_conf=False — no wasted link RTTs)."""
         conf = None
-        for slot in slots:
+        for i, slot in enumerate(slots):
             position = self._next_position()
             buf, valid, conf = await self.batcher.stream_update(
                 self.texts.get(slot, ""),
@@ -327,6 +329,7 @@ class StreamingSelfConsistency:
                 self._valid,
                 position,
                 self.temperature,
+                want_conf=i == len(slots) - 1,
             )
             self._commit(slot, buf, valid)
         self._publish(conf)

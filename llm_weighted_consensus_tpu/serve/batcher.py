@@ -128,15 +128,26 @@ class DeviceBatcher:
         )
 
     async def stream_update(
-        self, text: str, buf, valid, position: int, temperature: float = 0.05
+        self,
+        text: str,
+        buf,
+        valid,
+        position: int,
+        temperature: float = 0.05,
+        want_conf: bool = True,
     ):
         """One streaming-consensus update -> (buf, valid, confidence[CAP]).
         Batches with updates from other live streams at the same capacity
-        bucket (vmapped embed + scatter + masked revote)."""
+        bucket (vmapped embed + scatter + masked revote).
+
+        ``want_conf=False`` skips the host confidence fetch (conf returns
+        None): a stream folding K candidates in one burst reads only the
+        LAST confidence, and K synchronous link round-trips for discarded
+        intermediates would undo the batching win."""
         return await self._submit(
             "stream",
             ("stream", int(buf.shape[0]), float(temperature)),
-            (text, buf, valid, position, temperature),
+            (text, buf, valid, position, temperature, want_conf),
         )
 
     def close(self) -> None:
@@ -340,29 +351,34 @@ class DeviceBatcher:
 
     def _dispatch_stream(self, group: list) -> list:
         if len(group) == 1:
-            text, buf, valid, position, temperature = group[0].payload
+            text, buf, valid, position, temperature, want = group[0].payload
             out_buf, out_valid, conf = self.embedder.stream_vote_update(
                 text, buf, valid, position, temperature
             )
             # fetch here, on the device thread — a device-resident conf
             # would make the caller's np.asarray stall the event loop
             # for a link round-trip per update
-            return [(out_buf, out_valid, np.asarray(conf))]
+            return [(out_buf, out_valid, np.asarray(conf) if want else None)]
         texts = [item.payload[0] for item in group]
         bufs = [item.payload[1] for item in group]
         valids = [item.payload[2] for item in group]
         positions = [item.payload[3] for item in group]
         temperature = group[0].payload[4]
+        wants = [item.payload[5] for item in group]
         out_bufs, out_valids, confs = self.embedder.stream_vote_update_many(
             texts, bufs, valids, positions, temperature
         )
-        # fetch ALL confidences in ONE transfer here: every stream
+        # fetch ALL wanted confidences in ONE transfer here: every stream
         # np.asarray's its own confidence right after this returns, and
         # R separate slice fetches would re-serialize the round-trips
         # the batching just fused (R x link RTT per dispatch).  bufs /
         # valids stay device-resident — nobody reads them on host.
-        confs_host = np.asarray(confs)
+        confs_host = np.asarray(confs) if any(wants) else None
         return [
-            (out_bufs[i], out_valids[i], confs_host[i])
+            (
+                out_bufs[i],
+                out_valids[i],
+                confs_host[i] if wants[i] else None,
+            )
             for i in range(len(group))
         ]

@@ -69,18 +69,20 @@ async def _start_service(model: str, window_ms: float):
 
     from llm_weighted_consensus_tpu.serve import Config
     from llm_weighted_consensus_tpu.serve.__main__ import (
-        FAKE_PORT,
         _fake_upstream,
         build_service,
     )
 
+    fake_port = unused_port()
     config = Config.from_env(
         {
             "EMBEDDER_MODEL": model,
             "BATCH_WINDOW_MS": str(window_ms),
         }
     )
-    app = build_service(config, fake_upstream=True)
+    app = build_service(
+        config, fake_upstream=True, fake_upstream_port=fake_port
+    )
     # the embedder in build_service used the env tokenizer path; give it
     # the bench WordPiece vocab so tokenization cost matches bench.py
     from llm_weighted_consensus_tpu.serve.gateway import BATCHER_KEY
@@ -93,7 +95,7 @@ async def _start_service(model: str, window_ms: float):
     fake_app.router.add_post("/v1/chat/completions", _fake_upstream)
     fake_runner = web.AppRunner(fake_app)
     await fake_runner.setup()
-    await web.TCPSite(fake_runner, "127.0.0.1", FAKE_PORT).start()
+    await web.TCPSite(fake_runner, "127.0.0.1", fake_port).start()
 
     runner = web.AppRunner(app)
     await runner.setup()

@@ -64,6 +64,11 @@ curl -s "localhost:$PORT/embeddings" -H 'content-type: application/json' \
   -d '{"model": "test-tiny", "input": ["hello tpu"]}' \
   | python -c 'import json,sys; d=json.load(sys.stdin); print("dims:", len(d["data"][0]["embedding"]), "tokens:", d["usage"]["total_tokens"])'
 
+say "device self-consistency scorer as a service (POST /consensus)"
+curl -s "localhost:$PORT/consensus" -H 'content-type: application/json' \
+  -d '{"input": ["the answer is 42", "the answer is 42!", "cabbage"]}' \
+  | python -c 'import json,sys; d=json.load(sys.stdin); print("confidence:", [round(c, 3) for c in d["confidence"]], "tokens:", d["usage"]["prompt_tokens"])'
+
 say "archived completion as a candidate in a NEW request"
 curl -s "localhost:$PORT/score/completions" -H 'content-type: application/json' -d "{
   \"messages\": [{\"role\": \"user\", \"content\": \"re-judge\"}],

@@ -449,12 +449,18 @@ class _ArchivingClient:
                 )
 
 
-def build_service(config: Config, fake_upstream: bool = False):
+def build_service(
+    config: Config,
+    fake_upstream: bool = False,
+    fake_upstream_port: int = FAKE_PORT,
+):
     import os
 
     api_bases = config.api_bases()
     if fake_upstream:
-        api_bases = [ApiBase(f"http://127.0.0.1:{FAKE_PORT}/v1", "fake-key")]
+        api_bases = [
+            ApiBase(f"http://127.0.0.1:{fake_upstream_port}/v1", "fake-key")
+        ]
     if config.archive_path and os.path.exists(config.archive_path):
         store = archive.InMemoryArchive.load(config.archive_path)
     else:

@@ -1210,6 +1210,70 @@ def test_archive_streaming_tee_closes_inner_on_abandon():
     assert archived == []
 
 
+def test_archive_streaming_through_http_service():
+    """End-to-end over HTTP: build_service with ARCHIVE_STREAMING=1 + the
+    real fake-upstream server; a fully-consumed SSE stream archives its
+    folded unary (the manual drive from r3, as CI)."""
+    from aiohttp import web
+    from aiohttp.test_utils import unused_port
+
+    from llm_weighted_consensus_tpu.serve.__main__ import (
+        ARCHIVE_KEY,
+        _fake_upstream,
+        build_service,
+    )
+    from llm_weighted_consensus_tpu.utils import jsonutil
+
+    # ephemeral fake-upstream port: a fixed one would collide with any
+    # concurrently-running demo.sh gateway
+    fake_port = unused_port()
+    config = Config.from_env(
+        {"ARCHIVE_WRITE": "1", "ARCHIVE_STREAMING": "1"}
+    )
+    app = build_service(
+        config, fake_upstream=True, fake_upstream_port=fake_port
+    )
+    store = app[ARCHIVE_KEY]
+
+    async def run():
+        fake_app = web.Application()
+        fake_app.router.add_post("/v1/chat/completions", _fake_upstream)
+        fake = TestServer(fake_app, port=fake_port)
+        await fake.start_server()
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/score/completions",
+                data=jsonutil.dumps(
+                    {
+                        "stream": True,
+                        "messages": [{"role": "user", "content": "pick"}],
+                        "model": {"llms": [{"model": "fake-judge"}]},
+                        "choices": ["alpha", "beta"],
+                    }
+                ),
+                headers={"content-type": "application/json"},
+            )
+            text = await resp.text()
+            assert resp.status == 200
+            assert text.rstrip().endswith("data: [DONE]")
+        finally:
+            await client.close()
+            await fake.close()
+
+    go(run())
+    [cid] = store.score_ids()
+    completion = store.score_completion(cid)
+    # folded unary: candidates with confidence + the judge's vote, and
+    # the request + ballots beside it (learning inputs)
+    candidates = [c for c in completion.choices if c.model_index is None]
+    assert len(candidates) == 2
+    assert sum(float(c.confidence) for c in candidates) == pytest.approx(1.0)
+    assert store.score_request(cid) is not None
+    assert store.score_ballots(cid)
+
+
 def test_archive_streaming_abandoned_stream_not_archived():
     """A stream the client abandons mid-way archives nothing — a partial
     fold would look like a complete completion."""

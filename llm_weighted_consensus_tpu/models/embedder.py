@@ -27,27 +27,6 @@ from .tokenizer import BaseTokenizer, load_tokenizer
 DEFAULT_VOTE_TEMPERATURE = 0.05
 
 
-def _dyn_cosine_vote(emb, temperature):
-    """cosine_consensus_vote numerics with a TRACED temperature — user-
-    supplied temperatures must not be jit-static, or every distinct value
-    compiles a fresh encoder program (a recompile-DoS through the
-    /consensus endpoint)."""
-    from ..ops.similarity import l2_normalize
-
-    nrm = l2_normalize(emb)
-    sims = jnp.einsum(
-        "nd,md->nm",
-        nrm,
-        nrm,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    n = sims.shape[0]
-    off_diag = sims - jnp.eye(n, dtype=sims.dtype) * sims
-    mean_sim = jnp.sum(off_diag, axis=-1) / jnp.maximum(n - 1, 1)
-    return jax.nn.softmax(mean_sim / temperature)
-
-
 @partial(
     jax.jit, static_argnames=("n", "config", "pooling", "use_fused")
 )
@@ -64,6 +43,7 @@ def _embed_and_vote(
     dp-alignment padding (sliced off before the vote so they cannot
     perturb the softmax)."""
     from ..ops.kernels import fused_cosine_vote
+    from ..ops.similarity import dyn_cosine_vote
 
     emb = bert.embed(params, ids, mask, config, pooling=pooling)
     with jax.named_scope("consensus_vote"):
@@ -71,7 +51,7 @@ def _embed_and_vote(
             return fused_cosine_vote(
                 emb[:n], temperature=DEFAULT_VOTE_TEMPERATURE
             )
-        return _dyn_cosine_vote(emb[:n], temperature)
+        return dyn_cosine_vote(emb[:n], temperature)
 
 
 @partial(
@@ -90,23 +70,12 @@ def _embed_and_vote_many(
     kernel calls — compile time stays flat in R, and the caller buckets R
     to a power of two so only log2 specializations ever compile.  Rows
     past ``r*n`` are bucket/dp-alignment padding, sliced off pre-vote."""
-    from ..ops.similarity import l2_normalize
+    from ..ops.similarity import dyn_cosine_vote
 
     emb = bert.embed(params, ids, mask, config, pooling=pooling)
     emb = emb[: r * n].reshape(r, n, -1)
     with jax.named_scope("consensus_vote_many"):
-        nrm = l2_normalize(emb)
-        sims = jnp.einsum(
-            "rnd,rmd->rnm",
-            nrm,
-            nrm,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        eye = jnp.eye(n, dtype=sims.dtype)
-        off_diag = sims - eye[None, :, :] * sims
-        mean_sim = jnp.sum(off_diag, axis=-1) / jnp.maximum(n - 1, 1)
-        return jax.nn.softmax(mean_sim / temperature, axis=-1)
+        return dyn_cosine_vote(emb, temperature)
 
 
 @partial(

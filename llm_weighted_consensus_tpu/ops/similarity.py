@@ -46,6 +46,32 @@ def pairwise_cosine(x: jax.Array) -> jax.Array:
     return jnp.einsum("nd,md->nm", n, n, preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
 
 
+def dyn_cosine_vote(embeddings: jax.Array, temperature) -> jax.Array:
+    """``cosine_consensus_vote`` numerics with a TRACED temperature and
+    optional leading batch dims: embeddings[..., N, D] ->
+    confidence[..., N].
+
+    The ONE implementation of the vote math — the jitted static-
+    temperature wrapper below and the serving batched paths
+    (models/embedder.py) all reduce to this.  Temperature must be traced
+    on user-facing paths: a jit-static temperature would compile a fresh
+    program per distinct user value (a recompile-DoS through
+    POST /consensus).
+    """
+    nrm = l2_normalize(embeddings)
+    sims = jnp.einsum(
+        "...nd,...md->...nm",
+        nrm,
+        nrm,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    n = sims.shape[-1]
+    off_diag = sims - jnp.eye(n, dtype=sims.dtype) * sims
+    mean_sim = jnp.sum(off_diag, axis=-1) / jnp.maximum(n - 1, 1)
+    return jax.nn.softmax(mean_sim / temperature, axis=-1)
+
+
 @partial(jax.jit, static_argnames=("temperature",))
 def cosine_consensus_vote(
     embeddings: jax.Array, temperature: float = 0.05
@@ -57,11 +83,7 @@ def cosine_consensus_vote(
     get low.  ``temperature`` sharpens the softmax (0.05 suits bge-class
     cosine ranges).
     """
-    sims = pairwise_cosine(embeddings)
-    n = sims.shape[0]
-    off_diag = sims - jnp.eye(n, dtype=sims.dtype) * sims
-    mean_sim = jnp.sum(off_diag, axis=-1) / jnp.maximum(n - 1, 1)
-    return jax.nn.softmax(mean_sim / temperature)
+    return dyn_cosine_vote(embeddings, temperature)
 
 
 @jax.jit

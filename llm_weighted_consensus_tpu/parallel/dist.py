@@ -22,12 +22,11 @@ from __future__ import annotations
 import os
 from typing import Mapping, Optional
 
-_TRUTHY = ("1", "true", "yes", "on")
-
-
 def multihost_requested(env: Optional[Mapping] = None) -> bool:
+    from ..utils import env_truthy
+
     env = os.environ if env is None else env
-    return str(env.get("MULTIHOST", "")).lower() in _TRUTHY
+    return env_truthy(env.get("MULTIHOST", ""))
 
 
 def maybe_initialize_distributed(env: Optional[Mapping] = None) -> bool:

@@ -227,9 +227,11 @@ def _enable_compile_cache(path: str) -> None:
 def _synthetic_params_allowed(allow_synthetic: bool) -> bool:
     import os
 
-    return allow_synthetic or str(
+    from ..utils import env_truthy
+
+    return allow_synthetic or env_truthy(
         os.environ.get("LWC_ALLOW_RANDOM_PARAMS", "")
-    ).lower() in ("1", "true", "yes", "on")
+    )
 
 
 def build_embedder(config: Config, allow_synthetic: bool = False):

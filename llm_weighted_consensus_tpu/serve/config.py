@@ -81,7 +81,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..utils import jsonutil
+from ..utils import env_truthy, jsonutil
 
 
 def _non_negative_int(env: dict, name: str, default: int) -> int:
@@ -216,16 +216,10 @@ class Config:
             compile_cache_dir=env.get("COMPILE_CACHE_DIR"),
             profile_dir=env.get("PROFILE_DIR"),
             archive_path=env.get("ARCHIVE_PATH"),
-            archive_write=(
-                str(
-                    env.get("ARCHIVE_WRITE", "1" if env.get("ARCHIVE_PATH") else "0")
-                ).lower()
-                in ("1", "true", "yes", "on")
+            archive_write=env_truthy(
+                env.get("ARCHIVE_WRITE", "1" if env.get("ARCHIVE_PATH") else "0")
             ),
-            archive_streaming=(
-                str(env.get("ARCHIVE_STREAMING", "0")).lower()
-                in ("1", "true", "yes", "on")
-            ),
+            archive_streaming=env_truthy(env.get("ARCHIVE_STREAMING", "0")),
             archive_max_completions=_non_negative_int(
                 env, "ARCHIVE_MAX_COMPLETIONS", 65536
             ),

@@ -9,6 +9,11 @@ import uuid
 from . import jsonutil  # noqa: F401
 
 
+def env_truthy(value) -> bool:
+    """The framework's one definition of an env-flag truthy value."""
+    return str(value).lower() in ("1", "true", "yes", "on")
+
+
 class ChoiceIndexer:
     """Global choice-index allocator keyed ``(judge_index, native_index)``.
 

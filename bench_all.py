@@ -236,49 +236,39 @@ def bench_multichat_weighted(
 
 def bench_rm_reranking(n: int, seq: int, requests: int, state={}) -> dict:
     """Config 3: deberta-v3 RM scores candidates; softmax(reward) replaces
-    the cosine vote."""
-    import jax
-    import jax.numpy as jnp
-    from functools import partial
-
+    the cosine vote — through the PRODUCTION scorer (models/reranker.py,
+    the same path POST /consensus {"scorer": "rm"} serves)."""
     from bench import bench_spm_tokenizer
 
-    from llm_weighted_consensus_tpu.models import deberta
-    from llm_weighted_consensus_tpu.models.configs import DEBERTA_V3_BASE
+    from llm_weighted_consensus_tpu.models.reranker import TpuReranker
 
-    config = DEBERTA_V3_BASE
-    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     # random-init RM weights (no deberta checkpoint in this image) but the
     # REAL host path: unigram spm tokenization via models/spm.py — real
-    # checkpoints load with load_params + the spm.model beside them.
-    # params cached across the two reproducibility runs (init is slow)
-    if "params" not in state:
-        state["params"] = deberta.init_params(
-            jax.random.PRNGKey(0), config, dtype=dtype
+    # checkpoints load with load_rm_params + the spm.model beside them.
+    # reranker cached across the reproducibility runs (init is slow)
+    if "rr" not in state:
+        state["rr"] = TpuReranker(
+            "deberta-v3-base",
+            tokenizer=bench_spm_tokenizer(128100),
+            max_tokens=seq,
         )
-    params = state["params"]
-    tok = bench_spm_tokenizer(config.vocab_size)
+    reranker = state["rr"]
     reqs = make_requests(requests, n)
 
-    @partial(jax.jit, static_argnames=())
-    def rm_vote(params, ids, mask):
-        rewards = deberta.reward(params, ids, mask, config)
-        return deberta.reward_consensus_vote(rewards)
-
     def score(texts):
-        ids, mask = tok.encode_batch(texts, seq)
-        return rm_vote(params, jnp.asarray(ids), jnp.asarray(mask))
+        conf, _tokens = reranker.rerank_confidence(texts)
+        return conf
 
     for w in range(2):
-        np.asarray(score(reqs[w % len(reqs)]))
+        score(reqs[w % len(reqs)])
     lat = []
     for texts in reqs[: min(20, len(reqs))]:
         t0 = time.perf_counter()
-        np.asarray(score(texts))
+        score(texts)
         lat.append((time.perf_counter() - t0) * 1e3)
     pool = ThreadPoolExecutor(8)
     t0 = time.perf_counter()
-    futs = [pool.submit(np.asarray, score(texts)) for texts in reqs]
+    futs = [pool.submit(score, texts) for texts in reqs]
     for f in futs:
         f.result()
     total = time.perf_counter() - t0

@@ -150,14 +150,23 @@ def usable_positions(config: BertConfig) -> int:
 
 @dataclass(frozen=True)
 class DebertaConfig:
-    """DeBERTa-style encoder with disentangled relative attention."""
+    """DeBERTa-style encoder with disentangled relative attention.
+
+    ``position_buckets > 0`` selects DeBERTa-v3's LOG-bucketed relative
+    positions (HF ``make_log_bucket_position``: exact within ±buckets/2,
+    log-spaced beyond, out to ``max_relative_positions``) — the scheme
+    every released v3 checkpoint is trained with (rel table rows =
+    2 x buckets).  ``position_buckets = 0`` is the plain clamp scheme
+    (rel table rows = 2 x max_relative_positions).
+    """
 
     vocab_size: int = 128100
     hidden_size: int = 768
     num_layers: int = 12
     num_heads: int = 12
     intermediate_size: int = 3072
-    max_relative_positions: int = 128  # relative position bucket span k
+    max_relative_positions: int = 512  # furthest distinguishable distance
+    position_buckets: int = 256  # 0 = clamp scheme
     layer_norm_eps: float = 1e-7
     pad_token_id: int = 0
 
@@ -165,7 +174,18 @@ class DebertaConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
 
+    @property
+    def att_span(self) -> int:
+        """Half the relative-position table (HF ``pos_ebd_size``)."""
+        return (
+            self.position_buckets
+            if self.position_buckets > 0
+            else self.max_relative_positions
+        )
 
+
+# microsoft/deberta-v3-base shapes: max_relative_positions=-1 in HF
+# resolves to max_position_embeddings (512); position_buckets=256
 DEBERTA_V3_BASE = DebertaConfig()
 DEBERTA_TEST_TINY = DebertaConfig(
     vocab_size=512,
@@ -174,4 +194,5 @@ DEBERTA_TEST_TINY = DebertaConfig(
     num_heads=4,
     intermediate_size=128,
     max_relative_positions=16,
+    position_buckets=0,
 )

@@ -8,14 +8,19 @@ shared relative-position embedding table:
 
     score(i, j) = q_c[i]·k_c[j] + q_c[i]·k_r[d(i,j)] + k_c[j]·q_r[d(i,j)]
 
-scaled by 1/sqrt(3*head_dim), with d(i, j) the clamped relative distance.
-This keeps shapes static (the relative index matrix is precomputed per seq
+scaled by 1/sqrt(3*head_dim), with d(i, j) the bucketed relative distance
+(log-bucketed for v3 checkpoints, clamped otherwise — configs.py).  This
+keeps shapes static (the relative index matrix is precomputed per seq
 length) and every contraction on the MXU.
 
-The reward head is the standard RM recipe: CLS pooled state -> dense ->
-tanh -> dense(1) -> scalar reward per (prompt, candidate) sequence;
-``reward_consensus_vote`` turns N candidate rewards into a confidence
-distribution, slotting into the same tally as ballot votes.
+The reward head follows HF ``ContextPooler`` + 1-logit classifier: CLS
+pooled state -> dense -> exact-erf GELU -> dense(1) -> scalar reward per
+(prompt, candidate) sequence — numerics-pinned to
+``transformers.DebertaV2Model``/``ForSequenceClassification`` in
+tests/test_hf_parity.py, so real v3 RM checkpoints reproduce their
+trained rewards.  ``reward_consensus_vote`` turns N candidate rewards
+into a confidence distribution, slotting into the same tally as ballot
+votes.
 """
 
 from __future__ import annotations
@@ -30,7 +35,7 @@ from .layers import dense as _dense, dense_init as _dense_init, layer_norm as _l
 
 def init_params(rng, config: DebertaConfig, dtype=jnp.float32) -> dict:
     keys = jax.random.split(rng, 6)
-    h, i, k = config.hidden_size, config.intermediate_size, config.max_relative_positions
+    h, i, k = config.hidden_size, config.intermediate_size, config.att_span
 
     def layer_params(layer_rng):
         ks = jax.random.split(layer_rng, 8)
@@ -67,17 +72,41 @@ def init_params(rng, config: DebertaConfig, dtype=jnp.float32) -> dict:
     }
 
 
-def _rel_index(seq: int, k: int) -> jax.Array:
-    """[seq, seq] bucket indices: clamp(i - j, -k, k-1) + k."""
+def _rel_index(seq: int, config: DebertaConfig) -> jax.Array:
+    """[seq, seq] relative-position table indices in [0, 2*att_span).
+
+    ``position_buckets > 0``: HF ``make_log_bucket_position`` — positions
+    within ±buckets/2 index exactly, farther ones land in log-spaced
+    buckets out to ``max_relative_positions`` (how every released v3
+    checkpoint was trained).  Otherwise plain clamp."""
+    span = config.att_span
     pos = jnp.arange(seq)
     rel = pos[:, None] - pos[None, :]
-    return jnp.clip(rel, -k, k - 1) + k
+    if config.position_buckets > 0:
+        mid = config.position_buckets // 2
+        abs_pos = jnp.where(
+            (rel < mid) & (rel > -mid), mid - 1, jnp.abs(rel)
+        ).astype(jnp.float32)
+        log_pos = (
+            jnp.ceil(
+                jnp.log(abs_pos / mid)
+                / jnp.log((config.max_relative_positions - 1) / mid)
+                * (mid - 1)
+            )
+            + mid
+        )
+        rel = jnp.where(
+            jnp.abs(rel) <= mid,
+            rel,
+            (log_pos * jnp.sign(rel)).astype(rel.dtype),
+        )
+    return jnp.clip(rel, -span, span - 1) + span
 
 
 def _disentangled_attention(x, rel, p, mask_bias, config: DebertaConfig):
     b, s, h = x.shape
     nh, hd = config.num_heads, config.head_dim
-    k = config.max_relative_positions
+    k = config.att_span
 
     q_c = _dense(x, p["attn_q"]).reshape(b, s, nh, hd)
     k_c = _dense(x, p["attn_k"]).reshape(b, s, nh, hd)
@@ -86,7 +115,7 @@ def _disentangled_attention(x, rel, p, mask_bias, config: DebertaConfig):
     q_r = _dense(rel, p["pos_q"]).reshape(2 * k, nh, hd)
     k_r = _dense(rel, p["pos_k"]).reshape(2 * k, nh, hd)
 
-    rel_idx = _rel_index(s, k)  # [s, s]
+    rel_idx = _rel_index(s, config)  # [s, s]
 
     # content -> content
     c2c = jnp.einsum(
@@ -149,10 +178,17 @@ def reward(
     attention_mask: jax.Array,
     config: DebertaConfig,
 ) -> jax.Array:
-    """(prompt ++ candidate) token batch -> scalar reward per row [b]."""
+    """(prompt ++ candidate) token batch -> scalar reward per row [b].
+
+    Head = HF ``ContextPooler`` semantics (exact-erf GELU over a dense of
+    the CLS state — transformers' default ``pooler_hidden_act="gelu"``)
+    followed by the 1-logit classifier, so
+    ``DebertaV2ForSequenceClassification`` RM checkpoints reproduce their
+    trained rewards (tests/test_hf_parity.py)."""
     hidden = encode(params, input_ids, attention_mask, config)
     cls = hidden[:, 0, :].astype(jnp.float32)
-    z = jnp.tanh(_dense(cls, params["head_dense"]).astype(jnp.float32))
+    z = _dense(cls, params["head_dense"]).astype(jnp.float32)
+    z = jax.nn.gelu(z, approximate=False)
     return _dense(z, params["head_out"]).astype(jnp.float32)[:, 0]
 
 
@@ -163,3 +199,85 @@ def reward_consensus_vote(
     """rewards[N] -> confidence[N]: RM re-ranking as a consensus vote
     (drop-in for ops.similarity.cosine_consensus_vote)."""
     return jax.nn.softmax(rewards.astype(jnp.float32) / temperature)
+
+
+def from_hf_weights(
+    state_dict: dict, config: DebertaConfig, dtype=jnp.float32
+) -> dict:
+    """Map a HuggingFace DeBERTa-v2/v3 state dict into our pytree.
+
+    Accepts ``DebertaV2ForSequenceClassification`` reward models (e.g.
+    the OpenAssistant deberta-v3 RM family, BASELINE config 3): the
+    ``pooler.dense`` + ``classifier`` head maps onto ``head_dense`` /
+    ``head_out``; encoder-only checkpoints load with a random-init head
+    (fine-tune via train/).  v3 shares the content projections for the
+    disentangled position attention (HF ``share_att_key=True``, so the
+    checkpoint has no separate position projections) — our ``pos_q`` /
+    ``pos_k`` load the content ``query_proj`` / ``key_proj`` weights,
+    reproducing exactly that sharing.
+    """
+
+    def get(name):
+        return jnp.asarray(state_dict[name], dtype=dtype)
+
+    def dense(prefix):
+        # torch Linear stores [out, in]; ours is [in, out]
+        return {
+            "kernel": get(f"{prefix}.weight").T,
+            "bias": get(f"{prefix}.bias"),
+        }
+
+    def ln(prefix):
+        return {"scale": get(f"{prefix}.weight"), "bias": get(f"{prefix}.bias")}
+
+    def maybe_head(dense_prefix, fallback_shape_rng):
+        if f"{dense_prefix}.weight" in state_dict:
+            return dense(dense_prefix)
+        rng, in_dim, out_dim = fallback_shape_rng
+        from .layers import dense_init
+
+        return dense_init(rng, in_dim, out_dim, dtype)
+
+    layers = []
+    for i in range(config.num_layers):
+        base = f"encoder.layer.{i}"
+        q = dense(f"{base}.attention.self.query_proj")
+        k = dense(f"{base}.attention.self.key_proj")
+        layers.append(
+            {
+                "attn_q": q,
+                "attn_k": k,
+                "attn_v": dense(f"{base}.attention.self.value_proj"),
+                # share_att_key: position attention reuses content q/k
+                "pos_q": q,
+                "pos_k": k,
+                "attn_out": dense(f"{base}.attention.output.dense"),
+                "attn_ln": ln(f"{base}.attention.output.LayerNorm"),
+                "mlp_in": dense(f"{base}.intermediate.dense"),
+                "mlp_out": dense(f"{base}.output.dense"),
+                "mlp_ln": ln(f"{base}.output.LayerNorm"),
+            }
+        )
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+
+    h = config.hidden_size
+    rngs = jax.random.split(jax.random.PRNGKey(0), 2)
+    rel = get("encoder.rel_embeddings.weight")
+    want_rows = 2 * config.att_span
+    if rel.shape[0] != want_rows:
+        raise ValueError(
+            f"rel_embeddings has {rel.shape[0]} rows; config expects "
+            f"{want_rows} (2 x att_span) — set position_buckets="
+            f"{rel.shape[0] // 2} (v3 log-bucketed checkpoints) or "
+            f"max_relative_positions={rel.shape[0] // 2} with "
+            "position_buckets=0 (clamp scheme)"
+        )
+    return {
+        "token_embed": get("embeddings.word_embeddings.weight"),
+        "embed_ln": ln("embeddings.LayerNorm"),
+        "rel_embed": rel,
+        "rel_ln": ln("encoder.LayerNorm"),
+        "layers": stacked,
+        "head_dense": maybe_head("pooler.dense", (rngs[0], h, h)),
+        "head_out": maybe_head("classifier", (rngs[1], h, 1)),
+    }

@@ -369,6 +369,79 @@ def build_embedder(config: Config, allow_synthetic: bool = False):
     return embedder
 
 
+def build_reranker(config: Config, allow_synthetic: bool = False):
+    """The RM-scoring device side (POST /consensus {"scorer": "rm"}):
+    a DeBERTa reward model from env config.  Same synthetic-params
+    discipline as ``build_embedder``."""
+    if not config.rm_model:
+        return None
+    from ..models.reranker import RM_PRESETS, TpuReranker, load_rm_params
+    from ..models.spm import scheme_for_model
+    from ..models.tokenizer import HashTokenizer, load_tokenizer
+
+    if config.rm_model not in RM_PRESETS:
+        raise ValueError(
+            f"RM_MODEL={config.rm_model!r} is not a known preset; "
+            f"valid values: {', '.join(sorted(RM_PRESETS))}"
+        )
+    params = None
+    head_loaded = False
+    vocab_path = config.rm_vocab
+    if config.rm_weights:
+        from ..models.loading import find_vocab
+
+        params, head_loaded = load_rm_params(
+            config.rm_weights, RM_PRESETS[config.rm_model]
+        )
+        if not vocab_path:
+            vocab_path = find_vocab(config.rm_weights)
+    reranker = TpuReranker(
+        config.rm_model,
+        params=params,
+        tokenizer=(
+            load_tokenizer(
+                vocab_path, scheme=scheme_for_model(config.rm_model)
+            )
+            if vocab_path
+            else None
+        ),
+        max_tokens=config.rm_max_tokens,
+    )
+    synthetic = []
+    if params is None:
+        synthetic.append("random-init RM weights (no RM_WEIGHTS)")
+    elif not head_loaded:
+        synthetic.append(
+            "a RANDOM-INIT reward head (encoder-only checkpoint — no "
+            "pooler/classifier weights in RM_WEIGHTS)"
+        )
+    if isinstance(reranker.tokenizer, HashTokenizer):
+        synthetic.append(
+            "hash tokenizer (no RM_VOCAB and no vocab/spm file beside "
+            "RM_WEIGHTS)"
+        )
+    if synthetic:
+        detail = (
+            f"RM_MODEL={config.rm_model} would serve "
+            + " and ".join(synthetic)
+            + " — reward re-ranking would be garbage that looks valid."
+        )
+        if not _synthetic_params_allowed(allow_synthetic):
+            raise ValueError(
+                detail
+                + " Point RM_WEIGHTS at a checkpoint, or opt in with "
+                "LWC_ALLOW_RANDOM_PARAMS=1 (tests/demo only)."
+            )
+        import logging
+
+        logging.getLogger("lwc.serve").warning(
+            "SYNTHETIC RM PARAMS: %s Serving anyway "
+            "(LWC_ALLOW_RANDOM_PARAMS / fake-upstream demo mode).",
+            detail,
+        )
+    return reranker
+
+
 class _ArchivingClient:
     """Wraps a client so every served UNARY completion is archived (its id
     becomes referenceable by later requests); everything else delegates.
@@ -500,6 +573,7 @@ def build_service(
     # --fake-upstream is demo/test mode: synthetic embedder params are
     # allowed (still logged); production startup refuses them
     embedder = build_embedder(config, allow_synthetic=fake_upstream)
+    reranker = build_reranker(config, allow_synthetic=fake_upstream)
     batcher = None
     metrics = None
     if embedder is not None:
@@ -589,6 +663,7 @@ def build_service(
         metrics=metrics,
         profile_dir=config.profile_dir,
         batcher=batcher,
+        reranker=reranker,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables

@@ -37,6 +37,11 @@ TPU additions:
 * ``PROFILE_DIR`` — arms ``POST /profile/start`` / ``POST /profile/stop``:
   JAX profiler traces (xprof format, viewable in TensorBoard/xprof) are
   written under this directory.  Unset = endpoints disabled (404).
+* ``RM_MODEL`` / ``RM_WEIGHTS`` / ``RM_VOCAB`` / ``RM_MAX_TOKENS`` — a
+  DeBERTa reward model serving ``POST /consensus {"scorer": "rm"}``
+  (BASELINE config 3 as a service): candidates re-rank by
+  softmax(reward).  Same synthetic-params gate as the embedder; real
+  checkpoints load from HF DeBERTa-v2/v3 snapshots or orbax dirs.
 * ``ARCHIVE_PATH`` — JSON snapshot for the completions archive
   (checkpoint/resume): loaded at startup when the file exists, saved on
   graceful shutdown.  Unset = in-memory only.
@@ -138,6 +143,11 @@ class Config:
     embedder_weights: Optional[str] = None  # local checkpoint path
     embedder_vocab: Optional[str] = None  # path to vocab.txt
     embedder_max_tokens: Optional[int] = None  # None = context-aware default
+    # reward-model re-ranking service (POST /consensus {"scorer": "rm"})
+    rm_model: Optional[str] = None  # e.g. "deberta-v3-base"
+    rm_weights: Optional[str] = None  # local HF/orbax checkpoint
+    rm_vocab: Optional[str] = None  # spm.model / vocab.txt
+    rm_max_tokens: int = 512
     mesh_dp: Optional[int] = None
     mesh_tp: int = 1
     mesh_sp: Optional[int] = None
@@ -210,6 +220,10 @@ class Config:
                 if env.get("EMBEDDER_MAX_TOKENS")
                 else None
             ),
+            rm_model=env.get("RM_MODEL"),
+            rm_weights=env.get("RM_WEIGHTS"),
+            rm_vocab=env.get("RM_VOCAB"),
+            rm_max_tokens=int(env.get("RM_MAX_TOKENS", 512)),
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
             mesh_tp=int(env.get("MESH_TP", 1)),
             mesh_sp=int(env["MESH_SP"]) if env.get("MESH_SP") else None,

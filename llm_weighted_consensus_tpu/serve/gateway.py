@@ -253,6 +253,7 @@ def build_app(
     batcher=None,
     batch_window_ms: float = 3.0,
     batch_max: int = 64,
+    reranker=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
     if embedder is not None and batcher is None:
@@ -304,8 +305,10 @@ def build_app(
         app.router.add_post(
             "/embeddings", _embeddings_handler(embedder, metrics, batcher)
         )
+    if embedder is not None or reranker is not None:
         app.router.add_post(
-            "/consensus", _consensus_handler(embedder, metrics, batcher)
+            "/consensus",
+            _consensus_handler(embedder, metrics, batcher, reranker),
         )
 
     async def healthz(request):
@@ -330,16 +333,22 @@ def build_app(
 MAX_CONSENSUS_CANDIDATES = 256
 
 
-def _consensus_handler(embedder, metrics=None, batcher=None):
-    """POST /consensus: the device self-consistency scorer as a direct
-    service — N candidate texts in, the cosine consensus confidence
-    distribution out (one fused embed+vote dispatch; concurrent requests
-    coalesce via the micro-batcher).  This is the HTTP analog of the
-    headline bench path (bench.py N=64 self-consistency) — no reference
-    analog (its scoring always goes through judge LLMs; SURVEY §2.6).
+def _consensus_handler(embedder, metrics=None, batcher=None, reranker=None):
+    """POST /consensus: the device scorer as a direct service — N
+    candidate texts in, a confidence distribution out.
 
-    Body: {"input": [texts...], "temperature"?: float}.  Response:
-    {"model", "confidence": [...], "usage": {prompt_tokens, total_tokens}}.
+    Two scorers: ``"cosine"`` (default) is the embedding self-consistency
+    vote (one fused embed+vote dispatch; concurrent requests coalesce via
+    the micro-batcher — the HTTP analog of the headline bench path);
+    ``"rm"`` re-ranks by reward model: softmax(reward/T) over the
+    candidates, each scored against the optional ``prompt`` (BASELINE
+    config 3 as a service).  No reference analog (its scoring always
+    goes through judge LLMs; SURVEY §2.6).
+
+    Body: {"input": [texts...], "scorer"?: "cosine"|"rm",
+    "prompt"?: str, "temperature"?: float}.  Response: {"model",
+    "scorer", "confidence": [...], "usage": {prompt_tokens,
+    total_tokens}}.
     """
     import asyncio
 
@@ -362,7 +371,25 @@ def _consensus_handler(embedder, metrics=None, batcher=None):
                     f"`input` accepts at most {MAX_CONSENSUS_CANDIDATES} "
                     "candidates per request"
                 )
-            temperature = float(body.get("temperature", 0.05))
+            scorer = body.get("scorer", "cosine")
+            if scorer not in ("cosine", "rm"):
+                raise ValueError(
+                    "`scorer` must be 'cosine' or 'rm'"
+                )
+            if scorer == "cosine" and embedder is None:
+                raise ValueError(
+                    "cosine scorer unavailable: no EMBEDDER_MODEL configured"
+                )
+            if scorer == "rm" and reranker is None:
+                raise ValueError(
+                    "rm scorer unavailable: no RM_MODEL configured"
+                )
+            prompt = body.get("prompt")
+            if prompt is not None and not isinstance(prompt, str):
+                raise ValueError("`prompt` must be a string")
+            temperature = float(
+                body.get("temperature", 0.05 if scorer == "cosine" else 1.0)
+            )
             import math
 
             if not math.isfinite(temperature) or temperature <= 0:
@@ -377,9 +404,25 @@ def _consensus_handler(embedder, metrics=None, batcher=None):
                 text=jsonutil.dumps({"code": 400, "message": str(e)}),
                 content_type="application/json",
             )
+        loop = asyncio.get_running_loop()
         try:
-            if batcher is not None:
+            if scorer == "rm":
+                t0 = _time.perf_counter()
+                conf, tokens = await loop.run_in_executor(
+                    None,
+                    lambda: reranker.rerank_confidence(
+                        texts, prompt=prompt, temperature=temperature
+                    ),
+                )
+                if metrics is not None:
+                    metrics.observe(
+                        "device:rm_vote",
+                        (_time.perf_counter() - t0) * 1e3,
+                    )
+                model_name = reranker.model_name
+            elif batcher is not None:
                 conf, tokens = await batcher.consensus(texts, temperature)
+                model_name = embedder.model_name
             else:
 
                 def run():
@@ -392,16 +435,13 @@ def _consensus_handler(embedder, metrics=None, batcher=None):
                     )
 
                 t0 = _time.perf_counter()
-                conf, tokens = (
-                    await asyncio.get_running_loop().run_in_executor(
-                        None, run
-                    )
-                )
+                conf, tokens = await loop.run_in_executor(None, run)
                 if metrics is not None:
                     metrics.observe(
                         "device:consensus",
                         (_time.perf_counter() - t0) * 1e3,
                     )
+                model_name = embedder.model_name
         except Exception as e:
             return _error_response(e)
         import numpy as np
@@ -410,7 +450,8 @@ def _consensus_handler(embedder, metrics=None, batcher=None):
         return web.Response(
             text=jsonutil.dumps(
                 {
-                    "model": embedder.model_name,
+                    "model": model_name,
+                    "scorer": scorer,
                     "confidence": [float(c) for c in conf],
                     "usage": {
                         "prompt_tokens": tokens,

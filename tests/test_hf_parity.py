@@ -446,3 +446,179 @@ def test_usable_positions_and_bge_m3_preset():
     assert m3.position_style == "roberta"
     assert usable_positions(m3) == 8192
     assert usable_positions(PRESETS["bge-large-en"]) == 512
+
+
+# -- DeBERTa-v2/v3 parity (models/deberta.py vs transformers) -----------------
+
+
+DEBERTA_TINY_KW = dict(
+    vocab_size=128,
+    hidden_size=32,
+    num_heads=4,
+    intermediate_size=64,
+    max_relative_positions=8,
+    position_buckets=0,  # clamp scheme, matching position_buckets=-1 in HF
+)
+
+
+def _hf_deberta_cfg(**overrides):
+    base = dict(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=64,
+        relative_attention=True,
+        max_relative_positions=8,
+        # v3-style layout our model implements: clamp relative positions
+        # (position_buckets<1), shared content/position projections, no
+        # absolute position embeddings, LayerNormed rel table
+        position_buckets=-1,
+        pos_att_type=["p2c", "c2p"],
+        share_att_key=True,
+        norm_rel_ebd="layer_norm",
+        position_biased_input=False,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-7,
+    )
+    base.update(overrides)
+    return transformers.DebertaV2Config(**base)
+
+
+def test_deberta_encoder_matches_hf():
+    """Our disentangled-attention encoder vs transformers' DebertaV2Model
+    from the same weights: the c2c + c2p + p2c decomposition, clamp
+    bucketing, shared projections, and 1/sqrt(3d) scaling all line up."""
+    from llm_weighted_consensus_tpu.models import deberta
+    from llm_weighted_consensus_tpu.models.configs import DebertaConfig
+
+    torch.manual_seed(0)
+    hf = transformers.DebertaV2Model(_hf_deberta_cfg())
+    hf.eval()
+    cfg = DebertaConfig(num_layers=2, layer_norm_eps=1e-7, **DEBERTA_TINY_KW)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = deberta.from_hf_weights(state, cfg)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(3, 128, size=(2, 12)).astype(np.int32)
+    mask = np.ones_like(ids)
+    mask[1, 8:] = 0  # ragged row exercises the attention mask path
+    with torch.no_grad():
+        ref = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    ours = np.asarray(
+        deberta.encode(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+    )
+    # compare only unmasked positions: HF computes hidden states for
+    # padded slots too, but downstream consumers never read them
+    np.testing.assert_allclose(ours[0], ref[0], atol=1e-3)
+    np.testing.assert_allclose(ours[1, :8], ref[1, :8], atol=1e-3)
+
+
+def test_deberta_rm_head_loads_from_sequence_classification():
+    """DebertaV2ForSequenceClassification (the RM checkpoint layout) maps
+    pooler.dense/classifier onto head_dense/head_out, and the reward path
+    reproduces HF's logit."""
+    from llm_weighted_consensus_tpu.models import deberta
+    from llm_weighted_consensus_tpu.models.configs import DebertaConfig
+    from llm_weighted_consensus_tpu.models.reranker import (
+        _strip_deberta_prefix,
+    )
+
+    torch.manual_seed(1)
+    hf = transformers.DebertaV2ForSequenceClassification(
+        _hf_deberta_cfg(num_labels=1)
+    )
+    hf.eval()
+    cfg = DebertaConfig(num_layers=2, layer_norm_eps=1e-7, **DEBERTA_TINY_KW)
+    state = _strip_deberta_prefix(
+        {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    )
+    params = deberta.from_hf_weights(state, cfg)
+    # head weights really came from the checkpoint
+    np.testing.assert_allclose(
+        np.asarray(params["head_dense"]["kernel"]),
+        state["pooler.dense.weight"].T,
+        atol=1e-6,
+    )
+    ids = np.array([[3, 17, 42, 99, 5, 7]], dtype=np.int32)
+    mask = np.ones_like(ids)
+    with torch.no_grad():
+        ref = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).logits.numpy()[0, 0]
+    ours = float(
+        np.asarray(
+            deberta.reward(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+        )[0]
+    )
+    # HF's head is ContextPooler (dense -> GELU, dropout=0 here) +
+    # Linear — the same gelu(dense(cls)) -> linear our reward computes
+    assert abs(ours - ref) < 1e-3, (ours, ref)
+
+
+def test_deberta_encoder_only_checkpoint_random_head():
+    """Encoder-only state dicts load with a random-init head (fine-tune
+    via train/) instead of failing."""
+    from llm_weighted_consensus_tpu.models import deberta
+    from llm_weighted_consensus_tpu.models.configs import DebertaConfig
+
+    torch.manual_seed(2)
+    hf = transformers.DebertaV2Model(_hf_deberta_cfg())
+    cfg = DebertaConfig(num_layers=2, layer_norm_eps=1e-7, **DEBERTA_TINY_KW)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = deberta.from_hf_weights(state, cfg)
+    assert params["head_dense"]["kernel"].shape == (32, 32)
+    assert params["head_out"]["kernel"].shape == (32, 1)
+
+
+def test_deberta_log_bucketed_positions_match_hf():
+    """position_buckets > 0 (how every released v3 checkpoint is trained):
+    our make_log_bucket_position port must match HF for distances beyond
+    the exact window."""
+    from llm_weighted_consensus_tpu.models import deberta
+    from llm_weighted_consensus_tpu.models.configs import DebertaConfig
+
+    torch.manual_seed(3)
+    hf = transformers.DebertaV2Model(
+        _hf_deberta_cfg(position_buckets=4, max_relative_positions=16)
+    )
+    hf.eval()
+    cfg = DebertaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        intermediate_size=64,
+        max_relative_positions=16,
+        position_buckets=4,
+        layer_norm_eps=1e-7,
+    )
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = deberta.from_hf_weights(state, cfg)
+    rng = np.random.default_rng(4)
+    # seq 14 >> mid=2: most pairs land in the log-bucketed range
+    ids = rng.integers(3, 128, size=(1, 14)).astype(np.int32)
+    mask = np.ones_like(ids)
+    with torch.no_grad():
+        ref = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    ours = np.asarray(
+        deberta.encode(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+    )
+    np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+
+def test_deberta_v3_base_preset_matches_released_table_shape():
+    """DEBERTA_V3_BASE expects exactly the rel table every released v3
+    checkpoint ships (512 rows = 2 x position_buckets)."""
+    from llm_weighted_consensus_tpu.models.configs import DEBERTA_V3_BASE
+
+    assert DEBERTA_V3_BASE.att_span == 256
+    assert 2 * DEBERTA_V3_BASE.att_span == 512

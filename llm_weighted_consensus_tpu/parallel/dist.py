@@ -22,6 +22,28 @@ from __future__ import annotations
 import os
 from typing import Mapping, Optional
 
+def force_cpu_env(env: dict, n_devices: int) -> dict:
+    """Mutate+return ``env`` so a FRESH process initializes jax on
+    ``n_devices`` virtual CPU devices — the one place that knows how to
+    defeat the TPU-tunnel sitecustomize, which registers its PJRT plugin
+    whenever ``PALLAS_AXON_POOL_IPS`` is set and TRUMPS
+    ``JAX_PLATFORMS=cpu`` (with it loaded, ``jax.distributed`` bootstrap
+    is hijacked too: the group never forms).  Used by the DCN smoke's
+    worker processes and ``__graft_entry__``'s virtual-mesh dryruns."""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_PLATFORM_NAME", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = " ".join(
+        part
+        for part in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in part
+    )
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    return env
+
+
 def multihost_requested(env: Optional[Mapping] = None) -> bool:
     from ..utils import env_truthy
 

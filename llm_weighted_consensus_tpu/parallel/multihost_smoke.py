@@ -121,16 +121,19 @@ def _run_group_once(num_processes: int, timeout: float) -> list:
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
+    from .dist import force_cpu_env
+
     procs = []
     for pid in range(num_processes):
-        env = dict(os.environ)
+        # the smoke is about GROUP FORMATION, so workers run pure-CPU;
+        # force_cpu_env also defeats the TPU-tunnel sitecustomize, which
+        # would otherwise hijack the jax.distributed bootstrap
+        env = force_cpu_env(dict(os.environ), n_devices=1)
         env.update(
             MULTIHOST="1",
             COORDINATOR_ADDRESS=coordinator,
             NUM_PROCESSES=str(num_processes),
             PROCESS_ID=str(pid),
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=1",
             PYTHONPATH=repo_root
             + os.pathsep
             + os.environ.get("PYTHONPATH", ""),

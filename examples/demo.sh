@@ -17,13 +17,22 @@ say() { printf '\n\033[1m== %s ==\033[0m\n' "$*"; }
 
 say "starting gateway (fake upstream; archive + tables + profiler armed)"
 cd "$ROOT"
-JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+# the demo is a functional tour — run it on CPU even when a TPU tunnel is
+# ambient (the tunnel sitecustomize would trump JAX_PLATFORMS=cpu and pay
+# a link round-trip per init op; see parallel/dist.py force_cpu_env).
+# Set LWC_DEMO_PLATFORM to tour on real hardware instead — which needs
+# the tunnel plugin env kept, so only scrub it for the CPU default.
+if [ -z "${LWC_DEMO_PLATFORM:-}" ]; then
+  unset PALLAS_AXON_POOL_IPS JAX_PLATFORM_NAME
+fi
+JAX_PLATFORMS="${LWC_DEMO_PLATFORM:-cpu}" \
 EMBEDDER_MODEL=test-tiny EMBEDDER_MAX_TOKENS=32 \
+RM_MODEL=deberta-test-tiny RM_MAX_TOKENS=32 \
 ARCHIVE_PATH="$WORK/archive.json" TABLES_PATH="$WORK/tables.npz" \
 PROFILE_DIR="$WORK/traces" \
 python -m llm_weighted_consensus_tpu.serve --port "$PORT" --fake-upstream &
 GW_PID=$!
-for _ in $(seq 60); do
+for _ in $(seq 120); do
   curl -sf "localhost:$PORT/healthz" > /dev/null 2>&1 && break
   sleep 0.5
 done
@@ -68,6 +77,11 @@ say "device self-consistency scorer as a service (POST /consensus)"
 curl -s "localhost:$PORT/consensus" -H 'content-type: application/json' \
   -d '{"input": ["the answer is 42", "the answer is 42!", "cabbage"]}' \
   | python -c 'import json,sys; d=json.load(sys.stdin); print("confidence:", [round(c, 3) for c in d["confidence"]], "tokens:", d["usage"]["prompt_tokens"])'
+
+say "reward-model re-ranking on the same route (scorer: rm)"
+curl -s "localhost:$PORT/consensus" -H 'content-type: application/json' \
+  -d '{"input": ["the answer is 42", "probably 41"], "scorer": "rm", "prompt": "what is the answer?"}' \
+  | python -c 'import json,sys; d=json.load(sys.stdin); print("scorer:", d["scorer"], "model:", d["model"], "confidence:", [round(c, 3) for c in d["confidence"]])'
 
 say "archived completion as a candidate in a NEW request"
 curl -s "localhost:$PORT/score/completions" -H 'content-type: application/json' -d "{

@@ -236,3 +236,27 @@ def test_multihost_flag_parses_env(monkeypatch):
     # autodetection path: flag alone passes no kwargs
     assert dist.maybe_initialize_distributed({"MULTIHOST": "true"}) is True
     assert called[-1] == {}
+
+
+def test_force_cpu_env_scrubs_tunnel_plugin():
+    """The one canonical scrub (parallel.dist.force_cpu_env): pops the
+    tunnel-plugin vars, pins JAX_PLATFORMS=cpu, and rewrites the device
+    count while preserving unrelated XLA flags."""
+    from llm_weighted_consensus_tpu.parallel.dist import force_cpu_env
+
+    env = {
+        "PALLAS_AXON_POOL_IPS": "1.2.3.4",
+        "JAX_PLATFORM_NAME": "tpu",
+        "JAX_PLATFORMS": "axon",
+        "XLA_FLAGS": "--xla_foo=1 --xla_force_host_platform_device_count=3",
+        "OTHER": "kept",
+    }
+    out = force_cpu_env(env, 8)
+    assert out is env  # mutate+return contract
+    assert "PALLAS_AXON_POOL_IPS" not in out
+    assert "JAX_PLATFORM_NAME" not in out
+    assert out["JAX_PLATFORMS"] == "cpu"
+    assert out["OTHER"] == "kept"
+    assert "--xla_foo=1" in out["XLA_FLAGS"]
+    assert "--xla_force_host_platform_device_count=8" in out["XLA_FLAGS"]
+    assert out["XLA_FLAGS"].count("device_count") == 1

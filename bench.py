@@ -145,10 +145,17 @@ def measure_rtt_ms(reps: int = 10) -> float:
     return (time.perf_counter() - t0) / reps * 1e3
 
 
-def measure_device_only_ms(embedder, ids, mask, temperature=0.05) -> float:
+def measure_device_only_ms(
+    embedder, ids, mask, temperature=0.05, trials=5
+) -> tuple:
     """Amortized on-device time for one forward+vote, excluding the host
     link: run the body k times inside one dispatch (inputs varied per
-    iteration so XLA cannot hoist) and difference k=1 vs k=21."""
+    iteration so XLA cannot hoist) and difference k=1 vs k=21.  Returns
+    (median, sorted raw trials): each trial's two wall-clock samples carry
+    ~10 ms of tunnel jitter each (/20 after differencing), so a single
+    sample can swing +-2 ms — r3's apparent 32.8 -> 35.4 regression was
+    exactly this (VERDICT r3 item 1c); the median of 5 back-to-back
+    trials is stable and the spread is reported, not laundered."""
     import jax
     import jax.numpy as jnp
     from functools import partial
@@ -171,13 +178,17 @@ def measure_device_only_ms(embedder, ids, mask, temperature=0.05) -> float:
     dev_ids, dev_mask = jnp.asarray(ids), jnp.asarray(mask)
     float(rep(embedder.params, dev_ids, dev_mask, 1))
     float(rep(embedder.params, dev_ids, dev_mask, 21))
-    t0 = time.perf_counter()
-    float(rep(embedder.params, dev_ids, dev_mask, 1))
-    t1 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    float(rep(embedder.params, dev_ids, dev_mask, 21))
-    t21 = time.perf_counter() - t0
-    return max((t21 - t1) / 20 * 1e3, 1e-3)
+    samples = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(rep(embedder.params, dev_ids, dev_mask, 1))
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(rep(embedder.params, dev_ids, dev_mask, 21))
+        t21 = time.perf_counter() - t0
+        samples.append(max((t21 - t1) / 20 * 1e3, 1e-3))
+    samples.sort()
+    return samples[len(samples) // 2], [round(s, 2) for s in samples]
 
 
 def main() -> int:
@@ -217,6 +228,28 @@ def main() -> int:
         ids, mask = tokenize_fixed(embedder, texts, args.seq)
         return embedder.consensus_confidence_tokens(ids, mask)
 
+    def pipelined_rate(fn, reqs):
+        """Async dispatch + overlapped fetches (the serving shape): host
+        tokenizes request i+1 while the device runs request i; fetches
+        overlap on a small pool exactly like the asyncio gateway's
+        executor.  3 warm-up calls first (compile + steady-state: first
+        tunnel calls are slower)."""
+        for w in range(3):
+            warm = np.asarray(fn(reqs[w % len(reqs)]))
+        np.testing.assert_allclose(float(warm.sum()), 1.0, atol=1e-3)
+        fetch_pool = ThreadPoolExecutor(8)
+        futures = []
+        t_start = time.perf_counter()
+        for texts in reqs:
+            out = fn(texts)  # tokenize (host) + async dispatch
+            futures.append(fetch_pool.submit(np.asarray, out))
+            while sum(not f.done() for f in futures) > 32:
+                time.sleep(0.001)
+        results = [f.result() for f in futures]
+        total = time.perf_counter() - t_start
+        fetch_pool.shutdown()
+        return len(reqs) / total, results
+
     # warm-up: compile + steady-state (first tunnel calls are slower)
     for w in range(3):
         warm = np.asarray(consensus(requests[w % len(requests)]))
@@ -235,32 +268,37 @@ def main() -> int:
     # dispatch, nothing overlapped)
     if args.profile:
         jax.profiler.start_trace(args.profile)
-    t_start = time.perf_counter()
     if args.no_pipeline:
+        t_start = time.perf_counter()
         results = [np.asarray(consensus(texts)) for texts in requests]
+        answers_per_sec = len(requests) / (time.perf_counter() - t_start)
     else:
-        fetch_pool = ThreadPoolExecutor(8)
-        futures = []
-        for texts in requests:
-            out = consensus(texts)  # tokenize (host) + async dispatch
-            futures.append(fetch_pool.submit(np.asarray, out))
-            while sum(not f.done() for f in futures) > 32:
-                time.sleep(0.001)
-        results = [f.result() for f in futures]
-        fetch_pool.shutdown()
-    total = time.perf_counter() - t_start
+        answers_per_sec, results = pipelined_rate(consensus, requests)
     if args.profile:
         jax.profiler.stop_trace()
     for r in results:
         assert abs(float(np.sum(r)) - 1.0) < 1e-2
-
-    answers_per_sec = len(requests) / total
     p50 = statistics.median(latencies)
     ordered = sorted(latencies)
     p99 = ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
 
+    # the serving path's number: same corpus through embedder.tokenize,
+    # which seq-buckets (the ~104-token bench corpus lands in the 112
+    # bucket instead of padding to 128 — the padding-FLOPs recovery real
+    # traffic gets; the headline metric stays seq=128 by definition)
+    serving_seq = None
+    serving_rate = None
+    if not args.no_pipeline:
+        ids_b, _ = embedder.tokenize(requests[0])
+        serving_seq = ids_b.shape[1]
+        if serving_seq != args.seq:
+            rate, _ = pipelined_rate(
+                embedder.consensus_confidence, requests
+            )
+            serving_rate = round(rate, 3)
+
     ids0, mask0 = tokenize_fixed(embedder, requests[0], args.seq)
-    device_ms = measure_device_only_ms(embedder, ids0, mask0)
+    device_ms, device_ms_runs = measure_device_only_ms(embedder, ids0, mask0)
     rtt_ms = measure_rtt_ms()
     tflops = flops_per_answer(embedder.config, args.n, args.seq) / 1e12
     eff_tflops = tflops / (device_ms / 1e3)
@@ -281,6 +319,9 @@ def main() -> int:
                 "p50_ms": round(p50, 2),
                 "p99_ms": round(p99, 2),
                 "device_only_ms": round(device_ms, 2),
+                "device_only_ms_runs": device_ms_runs,
+                "serving_bucketed_answers_per_sec": serving_rate,
+                "serving_bucketed_seq": serving_seq,
                 "link_rtt_ms": round(rtt_ms, 1),
                 "effective_tflops": round(eff_tflops, 1),
                 "mfu_vs_v5e_peak": round(eff_tflops / V5E_BF16_PEAK_TFLOPS, 3),
@@ -290,9 +331,12 @@ def main() -> int:
                 "backend": backend,
                 "requests": len(requests),
                 "numerics": (
-                    "exact erf GELU (HF-checkpoint parity, "
-                    "tests/test_hf_parity.py); r1's 31/s used the tanh "
-                    "approximation, which diverges from real checkpoints"
+                    "erf GELU (HF-checkpoint parity, tests/test_hf_parity"
+                    ".py; r1's 31/s used the tanh approximation, which "
+                    "diverges from real checkpoints).  The bf16 path "
+                    "evaluates erf via the A&S erfc form on hardware exp "
+                    "— <=1 bf16 ulp vs exact erf, enumerated over every "
+                    "finite bf16 input in tests/test_models.py"
                 ),
             }
         )

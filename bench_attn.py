@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Attention-tiling microbenchmark (VERDICT r3 item 2 groundwork).
+
+Times, on the real chip, one encoder-shaped attention op under the
+candidate tilings so the crossover table in ``ops/attention.py`` is
+measured, not argued:
+
+* ``einsum``   — XLA batched einsum attention
+* ``fusedKh``  — re-tiled Pallas kernel, K flat (batch, head) tiles/step
+
+Timing uses k-rep fori_loop differencing (median of trials) so the
+~100 ms tunnel RTT and its jitter cancel out.  The headline decision is
+made on the IN-CONTEXT numbers from bench_fwd.py, not these — see the
+table in ops/attention.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def einsum_attention(q, k, v, bias, scale):
+    logits = (
+        jnp.einsum("bqnd,bknd->bnqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    logits = logits + bias[:, None, None, :]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum(
+        "bnqk,bknd->bqnd", probs, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def timed_ms(fn, params, reps_hi=201, trials=3):
+    """Amortized per-call ms via k=1 vs k=reps_hi fori_loop difference
+    (median of ``trials`` so ~100 ms tunnel jitter cannot swamp sub-ms
+    kernels)."""
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def rep(args, k):
+        def body(i, acc):
+            # chain acc into the input so XLA can neither hoist the body
+            # out of the loop nor run iterations concurrently (acc*1e-20
+            # is not foldable: x*0 != 0 for floats)
+            eps = (acc * 1e-20).astype(args[0].dtype)
+            out = fn(args[0] + eps, *args[1:])
+            return acc + jnp.sum(out.astype(jnp.float32))
+
+        return jax.lax.fori_loop(0, k, body, 0.0)
+
+    float(rep(params, 1))
+    float(rep(params, reps_hi))
+    samples = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(rep(params, 1))
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(rep(params, reps_hi))
+        thi = time.perf_counter() - t0
+        samples.append(max((thi - t1) / (reps_hi - 1) * 1e3, 1e-3))
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--b", type=int, default=64)
+    p.add_argument("--nh", type=int, default=16)
+    p.add_argument("--hd", type=int, default=64)
+    p.add_argument("--seqs", default="128,256,512")
+    p.add_argument("--ks", default="8,16,32")
+    args = p.parse_args()
+
+    from llm_weighted_consensus_tpu.ops.attention import fused_attention_tiled
+
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    rng = np.random.default_rng(0)
+    results = {}
+    for s in [int(x) for x in args.seqs.split(",")]:
+        shape = (args.b, s, args.nh, args.hd)
+        q = jnp.asarray(rng.standard_normal(shape), dtype)
+        k = jnp.asarray(rng.standard_normal(shape), dtype)
+        v = jnp.asarray(rng.standard_normal(shape), dtype)
+        bias = jnp.zeros((args.b, s), jnp.float32)
+        scale = 1.0 / float(args.hd) ** 0.5
+
+        row = {}
+        ref = einsum_attention(q, k, v, bias, scale)
+        row["einsum"] = timed_ms(
+            lambda q, k, v: einsum_attention(q, k, v, bias, scale), (q, k, v)
+        )
+        for kk in [int(x) for x in args.ks.split(",")]:
+            if (args.b * args.nh) % kk:
+                continue
+            try:
+                out = fused_attention_tiled(
+                    q, k, v, bias, scale, heads_per_step=kk
+                )
+                np.testing.assert_allclose(
+                    np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                    atol=3e-2, rtol=3e-2,
+                )
+                row[f"fused{kk}h"] = timed_ms(
+                    lambda q, k, v, kk=kk: fused_attention_tiled(
+                        q, k, v, bias, scale, heads_per_step=kk
+                    ),
+                    (q, k, v),
+                )
+            except Exception as e:  # noqa: BLE001 - report and move on
+                row[f"fused{kk}h"] = f"ERROR: {type(e).__name__}: {e}"[:200]
+        results[f"s={s}"] = row
+        print(json.dumps({f"s={s}": row}), flush=True)
+
+    print(json.dumps({"backend": jax.default_backend(), "results": results}))
+
+
+if __name__ == "__main__":
+    main()

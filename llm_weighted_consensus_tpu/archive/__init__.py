@@ -87,10 +87,16 @@ class InMemoryArchive(Fetcher):
         self._score_requests: dict = {}
         # FIFO of ballot cids not (yet) archived — the O(1) eviction
         # candidate queue for put_ballot (entries are lazily discarded
-        # when they turn out to be archived by the time they surface)
+        # when they turn out to be archived by the time they surface) —
+        # plus the live count of orphans (ballot cids NOT in _score):
+        # the cap must bound the orphan population, not total ballots,
+        # or an archive holding >cap archived-with-ballots completions
+        # would drain every in-flight request's ballots on each
+        # put_ballot (ADVICE r3)
         from collections import deque
 
         self._ballot_orphans = deque()
+        self._n_orphan_ballots = 0
 
     def _evict_over_cap(self, table: dict) -> None:
         if self.max_completions is None:
@@ -115,6 +121,11 @@ class InMemoryArchive(Fetcher):
         return completion.id
 
     def put_score(self, completion) -> str:
+        if completion.id not in self._score and completion.id in self._ballots:
+            # orphan -> archived transition: its ballots leave the capped
+            # population (revote needs them for as long as the completion
+            # lives)
+            self._n_orphan_ballots -= 1
         self._score[completion.id] = completion
         self._evict_over_cap(self._score)
         return completion.id
@@ -146,27 +157,39 @@ class InMemoryArchive(Fetcher):
         ``ScoreClient(..., ballot_sink=store.put_ballot)``."""
         if completion_id not in self._ballots:
             self._ballot_orphans.append(completion_id)
+            if completion_id not in self._score:
+                self._n_orphan_ballots += 1
         self._ballots.setdefault(completion_id, {})[judge_index] = list(
             key_indices
         )
-        while len(self._ballots) > self.MAX_BALLOT_COMPLETIONS:
-            # the cap bounds ORPHANS (streaming requests whose completions
-            # never get archived), oldest first via the FIFO — O(1) per
-            # eviction, not a scan of every key.  Archived completions'
-            # ballots — and the in-flight request being recorded right now
-            # — are never evicted: revote needs the former, put_score
-            # hasn't had its chance at the latter.  When only those
-            # remain, growth is legitimate (it tracks the archive's size).
+        # the cap bounds ORPHANS (streaming requests whose completions
+        # never get archived), oldest first via the FIFO — O(1) amortized
+        # per eviction, not a scan of every key.  Archived completions'
+        # ballots — and the in-flight request being recorded right now —
+        # are never evicted: revote needs the former, put_score hasn't
+        # had its chance at the latter; neither counts against the cap
+        # (archived growth legitimately tracks the archive's size).
+        rotated = False
+        while self._n_orphan_ballots > self.MAX_BALLOT_COMPLETIONS:
             if not self._ballot_orphans:
                 break
             victim = self._ballot_orphans[0]
             if victim == completion_id:
-                break  # newest entry: only non-evictable ballots remain
+                if rotated:
+                    break  # full cycle: nothing else left to evict
+                # rotate the in-flight id to the back so eviction can
+                # continue past it to newer orphans (a late ballot for an
+                # old completion must not wedge the queue, ADVICE r3)
+                self._ballot_orphans.popleft()
+                self._ballot_orphans.append(completion_id)
+                rotated = True
+                continue
             self._ballot_orphans.popleft()
             if victim in self._score or victim not in self._ballots:
                 # archived since queued (keep forever) or already dropped
                 continue
             self._ballots.pop(victim)
+            self._n_orphan_ballots -= 1
 
     def score_ballots(self, completion_id: str) -> Optional[dict]:
         return self._ballots.get(completion_id)
@@ -265,6 +288,13 @@ class InMemoryArchive(Fetcher):
             cid: {int(judge): pairs for judge, pairs in judges.items()}
             for cid, judges in obj.get("ballots", {}).items()
         }
+        # rebuild the orphan queue/count the snapshot doesn't carry, so
+        # loaded not-yet-archived ballots stay evictable and the cap
+        # arithmetic starts consistent
+        for cid in store._ballots:
+            if cid not in store._score:
+                store._ballot_orphans.append(cid)
+                store._n_orphan_ballots += 1
         from ..types import score_request
 
         store._score_requests = {

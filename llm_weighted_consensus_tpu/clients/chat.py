@@ -188,10 +188,6 @@ class AiohttpTransport(Transport):
 
         return _Resp()
 
-    async def close(self) -> None:
-        if self._session is not None and not self._session.closed:
-            await self._session.close()
-
 
 # ---------------------------------------------------------------------------
 # The client

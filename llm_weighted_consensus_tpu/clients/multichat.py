@@ -271,7 +271,10 @@ class StreamingSelfConsistency:
     def _commit(self, slot: int, buf, valid) -> None:
         # updates are functional (new buffers returned), so nothing commits
         # until the dispatch succeeds: a raising embedder leaves no phantom
-        # slot behind and the candidate can retry later
+        # slot behind and the candidate can retry later.  (Host-side
+        # failures before dispatch keep the old buffers valid; the update
+        # jit donates them, so only an in-flight device failure — already
+        # fatal for the stream — can consume them without a replacement.)
         self._buf, self._valid = buf, valid
         self._order.append(slot)
 

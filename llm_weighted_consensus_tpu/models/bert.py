@@ -98,23 +98,38 @@ def _attention(x, p, mask_bias, config: BertConfig):
             ctx = ring_attention(
                 q, k, v, mask_bias[:, 0, 0, :], scale, config.ring_axis
             )
-    elif _use_fused_attention(config, s, hd):
-        from ..ops.attention import fused_attention
+    elif _use_fused_attention(config, b, s, hd, q.dtype):
+        from ..ops.attention import best_heads_per_step, fused_attention_tiled
 
         with jax.named_scope("fused_attention"):
             # mask_bias is [b, 1, 1, s]; the kernel wants the [b, s] key bias
-            ctx = fused_attention(q, k, v, mask_bias[:, 0, 0, :], scale)
+            ctx = fused_attention_tiled(
+                q,
+                k,
+                v,
+                mask_bias[:, 0, 0, :],
+                scale,
+                # forced mode may arrive with best==0 (caller takes the
+                # VMEM responsibility); run the minimal 1-tile step then
+                heads_per_step=max(
+                    best_heads_per_step(b, s, nh, hd, q.dtype.itemsize), 1
+                ),
+            )
     else:
         with jax.named_scope("einsum_attention"):
-            # [b, nh, s, s] logits accumulated in f32 on the MXU
+            # [b, nh, s, s] logits: f32 accumulation on the MXU, stored in
+            # the activation dtype like every other matmul in this module
+            # (bf16 storage halves the attention HBM traffic — the one
+            # materialized intermediate XLA cannot fuse away); softmax
+            # itself stays f32 per the module contract
             logits = (
                 jnp.einsum(
                     "bqnd,bknd->bnqk", q, k,
-                    preferred_element_type=jnp.float32,
+                    preferred_element_type=x.dtype,
                 )
                 * scale
             )
-            logits = logits + mask_bias  # [b, 1, 1, s] additive -inf padding
+            logits = logits + mask_bias.astype(x.dtype)  # [b, 1, 1, s]
             probs = jax.nn.softmax(
                 logits.astype(jnp.float32), axis=-1
             ).astype(x.dtype)
@@ -126,8 +141,10 @@ def _attention(x, p, mask_bias, config: BertConfig):
         return _dense(ctx.reshape(b, s, h), p["attn_out"])
 
 
-def _use_fused_attention(config: BertConfig, s: int, hd: int) -> bool:
-    from ..ops.attention import attention_fits
+def _use_fused_attention(
+    config: BertConfig, b: int, s: int, hd: int, dtype
+) -> bool:
+    from ..ops.attention import attention_fits, best_heads_per_step
 
     impl = config.attention_impl
     if impl == "einsum":
@@ -138,26 +155,58 @@ def _use_fused_attention(config: BertConfig, s: int, hd: int) -> bool:
         return True
     if not attention_fits(s, hd):
         return False
-    # "auto": measured on the real v5e chip (bge-large, bf16): at s=128 XLA's
-    # fused einsum attention is faster (31.2 vs 44.9 ms/fwd — the kernel's
-    # 1-head grid steps are overhead-bound); at s=512 the VMEM-resident
-    # kernel wins (39.5 vs 46.6 ms/fwd) because the [b, nh, s, s]
-    # intermediates stop round-tripping HBM.  Crossover set at 256.
-    return jax.default_backend() == "tpu" and s >= 256
+    if best_heads_per_step(b, s, config.num_heads, hd, dtype.itemsize) < 1:
+        # the kernel's own cost model says no tile fits (e.g. f32
+        # activations at s=1024): einsum, not a thrashing kernel
+        return False
+    # "auto": measured IN CONTEXT on the real v5e chip (bge-large, bf16,
+    # full forward, bench_fwd.py r4): einsum with bf16-stored logits wins
+    # at s=128/256/384 (31.97 vs 35.54; 36.46 vs 39.56; 30.50 vs 30.87
+    # ms/fwd) because XLA fuses the head transposes into the projection
+    # matmuls, while the Pallas kernel pays them as HBM passes; the
+    # VMEM-resident kernel wins at s=512 (42.79 vs 47.65) where the
+    # [b, nh, s, s] intermediates dominate.  Isolated-op numbers (where
+    # the kernel matches einsum at 128 and wins from 256) are in
+    # ops/attention.py — the in-context crossover is what serving pays.
+    return jax.default_backend() == "tpu" and s >= 512
 
 
 def _gelu_erf(x: jax.Array) -> jax.Array:
     """Exact (erf) GELU: HF BERT/bge checkpoints use hidden_act="gelu",
     which is erf-based — jax.nn.gelu's default tanh approximation would
-    silently diverge from real checkpoints (tests/test_hf_parity.py).
+    silently diverge from real checkpoints (tests/test_hf_parity.py): its
+    output differs from exact-erf GELU by up to 257 bf16 ulps and flips
+    the bf16 rounding of ~40% of inputs (measured, r4).
 
-    Computed in f32: XLA's *bf16* erf lowering is ~7x slower on TPU than
-    the f32 one (measured on v5e: 41 ms vs 11 ms for 24 layers of
-    [8192, 4096]; tanh-approx is 6.4 ms), so upcast-erf-downcast is both
-    exact and nearly free relative to in-dtype erf."""
+    f32 inputs always take XLA's exact erf, upcast from bf16 would too be
+    exact — but for bf16 activations the erf lowering's ~12-op polynomial
+    is the single largest non-matmul cost in the encoder forward
+    (~2.7 ms of the 33.5 ms bge-large N=64/s=128 forward, bench_fwd.py).
+    The bf16 path instead uses the Abramowitz-Stegun 7.1.26 erfc form,
+    which rides the TPU's hardware exp: design error 2.2e-7 absolute
+    (f64), and after bf16 rounding it agrees with the exact-erf f32 GELU
+    to <=1 bf16 ulp on ALL finite bf16 inputs x >= -3 (<2% of them flip
+    by that 1 ulp — inherent to any f32 evaluation near rounding
+    midpoints) and to 2e-5 absolute in the deep tail (|gelu| < 0.003,
+    where f32 cancellation in the polynomial shows).  Asserted
+    exhaustively over every finite bf16 input in tests/test_models.py."""
     x32 = x.astype(jnp.float32)
-    out = x32 * 0.5 * (1.0 + jax.lax.erf(x32 * (2.0 ** -0.5)))
-    return out.astype(x.dtype)
+    if x.dtype != jnp.bfloat16:
+        out = x32 * 0.5 * (1.0 + jax.lax.erf(x32 * (2.0 ** -0.5)))
+        return out.astype(x.dtype)
+    z = jnp.abs(x32) * (2.0 ** -0.5)
+    t = 1.0 / (1.0 + 0.3275911 * z)
+    poly = t * (
+        0.254829592
+        + t
+        * (
+            -0.284496736
+            + t * (1.421413741 + t * (-1.453152027 + t * 1.061405429))
+        )
+    )
+    half_erfc = 0.5 * poly * jnp.exp(-z * z)
+    phi = jnp.where(x32 > 0, 1.0 - half_erfc, half_erfc)
+    return (x32 * phi).astype(x.dtype)
 
 
 def _layer(x, p, mask_bias, config: BertConfig):

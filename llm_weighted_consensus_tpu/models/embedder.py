@@ -79,7 +79,16 @@ def _embed_and_vote_many(
 
 
 @partial(
-    jax.jit, static_argnames=("config", "pooling")
+    jax.jit,
+    static_argnames=("config", "pooling"),
+    # buf/valid are the canonical donation case: same-shape state in, new
+    # state out — XLA aliases them in place, so the steady-state stream
+    # loop allocates nothing (SURVEY §7's last hard-part; VERDICT r3
+    # item 1a).  Callers MUST rebind to the returned buffers (they all
+    # do: the old ones are dead after this call).  ids/mask are NOT
+    # donated: int32 inputs alias no f32 output, so XLA ignores the
+    # donation and warns — measured no-op.
+    donate_argnums=(3, 4),
 )
 def _stream_vote_update(
     params, ids, mask, buf, valid, position, config, pooling, temperature
@@ -99,7 +108,9 @@ def _stream_vote_update(
 
 
 @partial(
-    jax.jit, static_argnames=("config", "pooling")
+    jax.jit,
+    static_argnames=("config", "pooling"),
+    donate_argnums=(3, 4),  # see _stream_vote_update
 )
 def _stream_vote_update_many(
     params, ids, mask, bufs, valids, positions, config, pooling, temperature
@@ -131,6 +142,27 @@ def _bucket(n: int, cap: int) -> int:
     while size < n:
         size *= 2
     return min(size, cap)
+
+
+# Sequence-length buckets: multiples of 16 up to 128 (XLA tiles cleanly at
+# /8 boundaries; the fused-attention block constraint needs /8 too), then
+# sparse above, doubling past 512 so long-context presets (bge-m3 8k)
+# keep a bounded jit-specialization count.  Finer than the power-of-two
+# batch buckets on purpose: a ~100-token corpus padding to 128 pays 23%
+# padding FLOPs (VERDICT r3 item 1b), while a 112 bucket recovers most of
+# it, and the bucket count stays small enough that lazy jit
+# specialization is cheap (compile is per-bucket, once).
+_SEQ_BUCKETS = (
+    16, 32, 48, 64, 80, 96, 112, 128, 192, 256, 384, 512,
+    1024, 2048, 4096, 8192,
+)
+
+
+def _seq_bucket(n: int, cap: int) -> int:
+    for size in _SEQ_BUCKETS:
+        if size >= n:
+            return min(size, cap)
+    return min(n, cap)
 
 
 class TpuEmbedder:
@@ -192,7 +224,7 @@ class TpuEmbedder:
     def tokenize(self, texts: Iterable[str], max_tokens: Optional[int] = None):
         cap = min(max_tokens or self.max_tokens, self.max_tokens)
         ids, mask = self.tokenizer.encode_batch(list(texts), cap)
-        seq = _bucket(int(mask.sum(axis=1).max(initial=1)), cap)
+        seq = _seq_bucket(int(mask.sum(axis=1).max(initial=1)), cap)
         return ids[:, :seq], mask[:, :seq]
 
     def embed_texts(

@@ -27,7 +27,13 @@ def ln_init(dim: int, dtype) -> dict:
 def layer_norm(x, params: dict, eps: float):
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
+    # one-pass variance (E[x^2] - mean^2, clamped): both reductions fuse
+    # into a single read of x, unlike jnp.var's subtract-then-reduce
+    # second pass — worth ~0.3 ms/fwd at the headline shape (r4).  The
+    # cancellation risk is bounded: LN inputs are O(1-10) f32, and flax
+    # LayerNorm uses the same formulation.
+    meansq = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    var = jnp.maximum(meansq - mean * mean, 0.0)
     normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
     return (
         normed * params["scale"].astype(jnp.float32)

@@ -127,10 +127,17 @@ def test_stream_updates_batch_across_streams(embedder):
         )
 
     (b1, v1, c1), (b2, v2, c2) = go(run())
-    rb, rv, rc = embedder.stream_vote_update("alpha", buf, valid, 0)
+    # the update jits donate buf/valid, so each reference call gets its
+    # own copy (the production contract: a buffer is passed to exactly
+    # one dispatch, then rebound from the result)
+    rb, rv, rc = embedder.stream_vote_update(
+        "alpha", jnp.array(buf), jnp.array(valid), 0
+    )
     np.testing.assert_allclose(np.asarray(b1), np.asarray(rb), atol=1e-5)
     np.testing.assert_allclose(np.asarray(v1), np.asarray(rv), atol=1e-5)
-    rb2, _, _ = embedder.stream_vote_update("beta", buf, valid, 0)
+    rb2, _, _ = embedder.stream_vote_update(
+        "beta", jnp.array(buf), jnp.array(valid), 0
+    )
     np.testing.assert_allclose(np.asarray(b2), np.asarray(rb2), atol=1e-5)
     assert metrics.snapshot()["series"]["device:batch:stream"]["count"] == 1
 

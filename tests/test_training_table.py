@@ -397,19 +397,26 @@ def test_ballot_table_is_bounded():
     assert store.score_ballots(f"scrcpl-{cap + 9}") is not None
 
 
+class _ScoreStub:
+    def __init__(self, cid):
+        self.id = cid
+
+
 def test_ballot_eviction_prefers_unarchived():
     """FIFO eviction must never drop an ARCHIVED completion's ballots —
-    those are exactly the ones revote still needs."""
+    those are exactly the ones revote still needs — and archived entries
+    do not count against the orphan cap."""
     from llm_weighted_consensus_tpu import archive
 
     store = archive.InMemoryArchive()
     cap = store.MAX_BALLOT_COMPLETIONS
     store.put_ballot("scrcpl-keep", 0, [("`A`", 0)])
-    store._score["scrcpl-keep"] = object()  # archived (stub is enough)
+    store.put_score(_ScoreStub("scrcpl-keep"))  # archived
     for i in range(cap + 5):
         store.put_ballot(f"scrcpl-{i}", 0, [("`A`", 0)])
     assert store.score_ballots("scrcpl-keep") is not None
-    assert len(store._ballots) == cap
+    # cap orphans + the archived one
+    assert len(store._ballots) == cap + 1
 
 
 # -- training-table learning from archived outcomes ---------------------------
@@ -722,11 +729,58 @@ def test_ballot_cap_never_starves_inflight_or_archived():
     for i in range(cap):
         cid = f"scrcpl-{i}"
         store.put_ballot(cid, 0, [("`A`", 0)])
-        store._score[cid] = object()  # archived
+        store.put_score(_ScoreStub(cid))  # archived
     store.put_ballot("scrcpl-inflight", 0, [("`A`", 0)])
     assert store.score_ballots("scrcpl-inflight") is not None
     # archived ones all retained too (growth beyond cap is the archive's)
     assert store.score_ballots("scrcpl-0") is not None
+
+
+def test_archived_ballots_beyond_cap_do_not_drain_inflight_orphans():
+    """ADVICE r3: an archive holding more than MAX_BALLOT_COMPLETIONS
+    archived-with-ballots completions must NOT put the eviction loop
+    permanently over cap — concurrent in-flight requests' ballots all
+    survive each other's put_ballot calls."""
+    from llm_weighted_consensus_tpu import archive
+
+    store = archive.InMemoryArchive()
+    store.MAX_BALLOT_COMPLETIONS = 4  # instance override: cheap test
+    for i in range(10):  # 10 archived-with-ballots > cap of 4
+        cid = f"scrcpl-{i}"
+        store.put_ballot(cid, 0, [("`A`", 0)])
+        store.put_score(_ScoreStub(cid))
+    # interleaved in-flight requests: under the old total-ballots cap,
+    # every put_ballot here drained the OTHER request's orphan ballots
+    store.put_ballot("scrcpl-a", 0, [("`A`", 0)])
+    store.put_ballot("scrcpl-b", 0, [("`A`", 0)])
+    store.put_ballot("scrcpl-a", 1, [("`B`", 1)])
+    store.put_ballot("scrcpl-b", 1, [("`B`", 1)])
+    assert store.score_ballots("scrcpl-a") == {0: [("`A`", 0)], 1: [("`B`", 1)]}
+    assert store.score_ballots("scrcpl-b") == {0: [("`A`", 0)], 1: [("`B`", 1)]}
+    assert len(store._ballots) == 12  # 10 archived + 2 orphans
+
+
+def test_late_ballot_for_oldest_orphan_does_not_wedge_eviction():
+    """ADVICE r3: when the FIFO front IS the in-flight completion (a late
+    ballot for an old, still-orphaned completion), eviction must rotate
+    past it and keep draining newer orphans instead of breaking while
+    over cap."""
+    from llm_weighted_consensus_tpu import archive
+
+    store = archive.InMemoryArchive()
+    store.put_ballot("scrcpl-x", 0, [("`A`", 0)])  # oldest orphan
+    for i in range(5):
+        store.put_ballot(f"scrcpl-y{i}", 0, [("`A`", 0)])
+    # drop the cap below the live orphan count, then deliver a late
+    # ballot for the FIFO-front completion
+    store.MAX_BALLOT_COMPLETIONS = 4
+    store.put_ballot("scrcpl-x", 1, [("`B`", 1)])
+    # the in-flight front survives; the OLDEST other orphans were evicted
+    assert store.score_ballots("scrcpl-x") is not None
+    assert store.score_ballots("scrcpl-y0") is None
+    assert store.score_ballots("scrcpl-y1") is None
+    assert store.score_ballots("scrcpl-y4") is not None
+    assert len(store._ballots) == 4
 
 
 def test_second_panel_learns_from_same_archive(embedder):

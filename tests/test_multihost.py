@@ -17,11 +17,31 @@ from llm_weighted_consensus_tpu.parallel.multihost_smoke import (
 
 
 def test_two_process_group_tallies_and_agrees():
-    confs = run_group(num_processes=2)
-    assert len(confs) == 2
+    results = run_group(num_processes=2)
+    assert len(results) == 2
+    confs = [r["confidence"] for r in results]
     np.testing.assert_allclose(confs[0], confs[1], atol=1e-7)
     np.testing.assert_allclose(confs[0], expected_confidence(), atol=1e-5)
     np.testing.assert_allclose(sum(confs[0]), 1.0, atol=1e-6)
+
+
+def test_two_process_four_device_mesh_runs_tp_inside_dp_across():
+    """VERDICT r3 item 5: 2 processes x 4 virtual devices, global
+    (dp=2, tp=4) mesh.  The TP-sharded encoder forward EXECUTES with the
+    DESIGN.md axis placement — run_group's gate asserts process_count=2,
+    8 global devices, sharded==unsharded numerics, >=1 within-process
+    collective (the Megatron all-reduces), and that every process-
+    crossing replica group has exactly dp participants (tp never rides
+    DCN)."""
+    results = run_group(num_processes=2, devices_per_proc=4)
+    assert len(results) == 2
+    for r in results:
+        assert r["num_processes"] == 2
+        assert r["global_devices"] == 8
+        assert r["within_process_groups"] >= 1
+        assert r["crossing_groups"] >= 1
+        assert r["crossing_group_sizes"] == [2]
+        assert r["encoder_max_err_vs_unsharded"] <= 2e-4
 
 
 def test_expected_confidence_fixture():

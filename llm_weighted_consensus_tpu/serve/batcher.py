@@ -274,7 +274,19 @@ class DeviceBatcher:
     def _group(self, batch: list):
         """Compatible-work groups, arrival order preserved, each at most
         ``max_batch`` items AND ``max_rows`` encoder rows (so one burst
-        splits into pipeline-overlappable dispatches)."""
+        splits into pipeline-overlappable dispatches).
+
+        Consensus groups whose pow2-bucket padding would waste more than
+        a quarter of the device rows are additionally split into
+        power-of-two chunks (9 -> 8+1): the consensus device path buckets
+        the request dimension to the next power of two (a full-encoder
+        jit specialization per bucket, so buckets must stay coarse), and
+        e.g. a 9-request group padded to 16 would burn 44% of its rows
+        embedding [PAD] slots.  Chunks reuse the already-compiled
+        specializations and pipeline (``pipeline_depth``); mild padding
+        (<=25%) is kept whole because an extra dispatch costs a pipeline
+        slot (~a link round-trip on a tunnel) — not worth a few pad rows
+        (r4 code-review finding)."""
         groups: dict = {}
         order = []
         for item in batch:
@@ -292,12 +304,39 @@ class DeviceBatcher:
                     len(group) >= self.max_batch
                     or rows + r > self.max_rows
                 ):
-                    yield group
+                    yield from self._pow2_chunks(group)
                     group, rows = [], 0
                 group.append(item)
                 rows += r
             if group:
-                yield group
+                yield from self._pow2_chunks(group)
+
+    @staticmethod
+    def _pow2_chunks(group: list):
+        """Split a group into pow2-sized chunks wherever the padded
+        single dispatch would waste >25% of its rows; otherwise pass it
+        through whole (see _group docstring for the trade).  Only the
+        consensus kind benefits: embed batches pad total ROWS, not
+        items, and the stream path's R bucket has a minimum of 16, so
+        chunking small stream groups would strictly ADD padding and
+        dispatches."""
+        if group[0].kind != "consensus":
+            yield group
+            return
+        start = 0
+        remaining = len(group)
+        while remaining:
+            bucket = 1
+            while bucket < remaining:
+                bucket *= 2
+            if (bucket - remaining) * 4 <= bucket:
+                # <=25% padding: one dispatch beats extra round-trips
+                yield group[start:]
+                return
+            size = bucket // 2  # largest pow2 below remaining
+            yield group[start : start + size]
+            start += size
+            remaining -= size
 
     # -- dispatch implementations (device thread) ------------------------------
 

@@ -92,7 +92,50 @@ def test_consensus_batches_same_shape(embedder):
     np.testing.assert_allclose(conf_a2, ref_a, atol=1e-5)
     assert tok_a == embedder.token_count(texts_a)
     assert tok_b == embedder.token_count(texts_b)
+    # 3 concurrent requests pad to the 4-bucket (25% waste, under the
+    # chunking threshold) and stay ONE dispatch
     assert metrics.snapshot()["series"]["device:batch:consensus"]["count"] == 1
+
+
+def test_consensus_groups_chunk_to_powers_of_two(embedder):
+    """The device path buckets the request dim to the next power of two;
+    the batcher splits groups whose padding would waste >25% of the
+    bucket: 5 concurrent same-shape requests (5 -> 8 would waste 37.5%)
+    dispatch as 4+1, all results exact."""
+    metrics = Metrics()
+    batcher = DeviceBatcher(embedder, metrics, window_ms=20.0)
+    texts = [f"candidate {i % 3}" for i in range(6)]
+
+    async def run():
+        return await asyncio.gather(
+            *(batcher.consensus(texts) for _ in range(5))
+        )
+
+    results = go(run())
+    ref = np.asarray(embedder.consensus_confidence(texts))
+    for conf, _tok in results:
+        np.testing.assert_allclose(conf, ref, atol=1e-5)
+    util = metrics.snapshot()["device_batcher"]
+    assert util["dispatches"] == 2 and util["items"] == 5
+
+    def chunk_sizes(n, kind="consensus"):
+        return [
+            len(c)
+            for c in DeviceBatcher._pow2_chunks(
+                [type("I", (), {"kind": kind})() for _ in range(n)]
+            )
+        ]
+
+    # <=25% padding stays whole; worse splits greedily, re-checking the
+    # threshold on each remainder
+    assert chunk_sizes(13) == [13]  # 16-bucket wastes 18.75%
+    assert chunk_sizes(63) == [63]  # one pad slot: never split
+    assert chunk_sizes(9) == [8, 1]  # 44% waste: split
+    assert chunk_sizes(11) == [8, 3]  # remainder 3 re-kept (25%)
+    assert chunk_sizes(8) == [8]
+    # stream groups pass through whole: their R bucket has a 16 minimum,
+    # chunking would strictly add padding and dispatches
+    assert chunk_sizes(5, kind="stream") == [5]
 
 
 def test_consensus_mixed_shapes_split_groups(embedder):

@@ -200,6 +200,13 @@ def main() -> int:
     parser.add_argument("--latency-requests", type=int, default=50)
     parser.add_argument("--no-pipeline", action="store_true")
     parser.add_argument(
+        "--quantize",
+        choices=("none", "int8"),
+        default="none",
+        help="int8 = W8A8 serving mode (models/quant.py; the metric "
+        "line reports which path ran — the headline stays bf16)",
+    )
+    parser.add_argument(
         "--profile",
         metavar="DIR",
         default=None,
@@ -221,6 +228,7 @@ def main() -> int:
         max_tokens=args.seq,
         dtype=dtype,
         tokenizer=bench_tokenizer(),
+        quantize=args.quantize,
     )
     requests = make_requests(args.requests, args.n)
 
@@ -329,6 +337,7 @@ def main() -> int:
                 "seq": args.seq,
                 "model": args.model,
                 "backend": backend,
+                "quantize": args.quantize,
                 "requests": len(requests),
                 "numerics": (
                     "erf GELU (HF-checkpoint parity, tests/test_hf_parity"

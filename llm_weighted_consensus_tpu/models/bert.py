@@ -77,6 +77,17 @@ def init_params(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _dense_cfg(x, p, config: BertConfig):
+    """The layer-dense op under the config's quantize mode: bf16/f32
+    param-dtype matmul (layers.dense) or the W8A8 int8-MXU twin
+    (models/quant.py) — selected statically, so the jit sees one path."""
+    if config.quantize == "int8":
+        from .quant import dense_int8
+
+        return dense_int8(x, p)
+    return _dense(x, p)
+
+
 def _attention(x, p, mask_bias, config: BertConfig):
     b, s, h = x.shape
     nh, hd = config.num_heads, config.head_dim
@@ -85,9 +96,9 @@ def _attention(x, p, mask_bias, config: BertConfig):
         return t.reshape(b, s, nh, hd)
 
     with jax.named_scope("qkv_proj"):
-        q = heads(_dense(x, p["attn_q"]))
-        k = heads(_dense(x, p["attn_k"]))
-        v = heads(_dense(x, p["attn_v"]))
+        q = heads(_dense_cfg(x, p["attn_q"], config))
+        k = heads(_dense_cfg(x, p["attn_k"], config))
+        v = heads(_dense_cfg(x, p["attn_v"], config))
     scale = 1.0 / float(hd) ** 0.5
     if config.attention_impl == "ring":
         # sequence-parallel ring attention: only valid inside a shard_map
@@ -138,7 +149,7 @@ def _attention(x, p, mask_bias, config: BertConfig):
                 preferred_element_type=jnp.float32,
             ).astype(x.dtype)
     with jax.named_scope("attn_out"):
-        return _dense(ctx.reshape(b, s, h), p["attn_out"])
+        return _dense_cfg(ctx.reshape(b, s, h), p["attn_out"], config)
 
 
 def _use_fused_attention(
@@ -212,7 +223,9 @@ def _gelu_erf(x: jax.Array) -> jax.Array:
 def _layer(x, p, mask_bias, config: BertConfig):
     attn = _attention(x, p, mask_bias, config)
     x = _layer_norm(x + attn, p["attn_ln"], config.layer_norm_eps)
-    mlp = _dense(_gelu_erf(_dense(x, p["mlp_in"])), p["mlp_out"])
+    mlp = _dense_cfg(
+        _gelu_erf(_dense_cfg(x, p["mlp_in"], config)), p["mlp_out"], config
+    )
     return _layer_norm(x + mlp, p["mlp_ln"], config.layer_norm_eps)
 
 

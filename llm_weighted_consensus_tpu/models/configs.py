@@ -30,6 +30,11 @@ class BertConfig:
     # framework's left-aligned masks that is an arange offset, so the
     # usable window is max_position_embeddings - pad_token_id - 1.
     position_style: str = "absolute"
+    # "none" (default) runs dense matmuls in the param dtype; "int8"
+    # expects params transformed by models.quant.quantize_bert_params and
+    # runs them W8A8 on the MXU's int8 path (2x bf16 peak on v5e) —
+    # opt-in serving mode, accuracy pinned in tests/test_quant.py
+    quantize: str = "none"
 
     @property
     def head_dim(self) -> int:

@@ -1,0 +1,104 @@
+"""Opt-in int8 quantization for the encoder's dense matmuls.
+
+The v5e MXU runs int8 x int8 -> int32 at twice the bf16 rate (394 vs 197
+TOPS), and the headline consensus forward is dense-matmul-bound (~25 of
+its ~32 ms, DESIGN.md r4 breakdown) — so a W8A8 path roughly halves the
+FLOP term on the serving hot path.  No reference analog (the reference
+delegates model compute to upstream HTTP APIs); this is a TPU-native
+serving optimization, OFF by default, selected per embedder
+(``TpuEmbedder(..., quantize="int8")`` / ``EMBEDDER_QUANTIZE=int8``).
+
+Scheme — the standard symmetric W8A8 recipe:
+
+* weights: per-OUTPUT-channel symmetric int8 (scale[out] = max|W[:,o]|/127,
+  quantized ONCE at load time);
+* activations: per-ROW dynamic symmetric int8 (scale[row] = max|x[row]|/127,
+  quantized at trace time inside the jit — XLA fuses the quant pass into
+  the surrounding elementwise work);
+* matmul: int8 x int8 with int32 accumulation on the MXU
+  (``preferred_element_type=int32`` — exact), dequantized by the rank-1
+  outer product of the two scales, bias added in the activation dtype.
+
+What stays un-quantized, deliberately: attention QK^T/PV (bf16, already
+cheap and softmax-sensitive), layernorm/softmax (f32 module contract),
+GELU (f32/A&S), embeddings/pooling.  Accuracy is pinned in
+tests/test_quant.py: per-matmul error bounds, end-to-end embedding cosine
+vs the bf16 path, and consensus-vote top-1 agreement on the committed
+golden checkpoint.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_weight(kernel: jax.Array):
+    """kernel[..., in, out] (f32/bf16) -> (int8 kernel, f32 scale[..., out]).
+
+    Per-output-channel symmetric: preserves each output feature's dynamic
+    range independently, which matters for LN-adjacent projections whose
+    channel magnitudes vary by orders of magnitude."""
+    k32 = kernel.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(k32), axis=-2) / 127.0  # [..., out]
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.round(k32 / scale[..., None, :])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _quantize_rows(x: jax.Array):
+    """x[..., rows, in] -> (int8 x, f32 scale[..., rows]) per-row dynamic."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32), axis=-1) / 127.0  # [..., rows]
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.round(x32 / scale[..., None])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dense_int8(x: jax.Array, p: dict) -> jax.Array:
+    """W8A8 dense: x[..., in] @ p["kernel_q"][in, out] -> [..., out].
+
+    int32 accumulation on the MXU (exact), dequantized by
+    act_scale x weight_scale, bias in the activation dtype — the
+    quantized twin of layers.dense."""
+    xq, sx = _quantize_rows(x)
+    acc = jax.lax.dot_general(
+        xq,
+        p["kernel_q"],
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * sx[..., None] * p["scale"]
+    return out.astype(x.dtype) + p["bias"]
+
+
+_QUANT_LAYER_KERNELS = (
+    "attn_q", "attn_k", "attn_v", "attn_out", "mlp_in", "mlp_out"
+)
+
+
+def is_quantized(params: dict) -> bool:
+    """Whether a bert param pytree carries the int8 layout — the ONE
+    structural probe (callers must not re-invent it: layout changes then
+    surface here, not as a silent misdetection at a second site)."""
+    return "kernel_q" in params.get("layers", {}).get("attn_q", {})
+
+
+def quantize_bert_params(params: dict) -> dict:
+    """bert param pytree -> its int8 twin (layer dense kernels quantized,
+    everything else untouched).  Pair with a config carrying
+    ``quantize="int8"`` so the forward takes the dense_int8 path."""
+    layers = dict(params["layers"])
+    for name in _QUANT_LAYER_KERNELS:
+        leaf = layers[name]
+        kq, scale = quantize_weight(leaf["kernel"])
+        layers[name] = {
+            "kernel_q": kq,
+            "scale": scale,
+            "bias": leaf["bias"],
+        }
+    out = dict(params)
+    out["layers"] = layers
+    return out

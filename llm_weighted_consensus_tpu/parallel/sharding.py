@@ -40,15 +40,41 @@ _TP_LAYER_SPECS = {
     "mlp_ln": {"scale": P(None), "bias": P(None)},
 }
 
+def _quantized_layer_specs() -> dict:
+    """The int8 twin of _TP_LAYER_SPECS, derived mechanically so the two
+    tables cannot drift: kernel_q shards like the full-precision kernel;
+    the per-output-channel scale follows the kernel's OUT (last) axis, so
+    it splits with column-split kernels and replicates with row-split
+    ones; bias/layernorm leaves are unchanged.  Row-split dequant
+    commutes with the tp all-reduce (scales are identical across shards:
+    sx[row] * sw[out] * sum(partials) == sum(sx * sw * partials)), so
+    the layout needs no numerics caveats."""
+    out = {}
+    for name, leaf in _TP_LAYER_SPECS.items():
+        if "kernel" not in leaf:
+            out[name] = dict(leaf)
+            continue
+        kspec = leaf["kernel"]
+        out[name] = {
+            "kernel_q": kspec,
+            "scale": P(None, kspec[-1]),  # [layer, out]: follows OUT
+            "bias": leaf["bias"],
+        }
+    return out
 
-def bert_param_specs(tp: bool = True) -> dict:
+
+_TP_LAYER_SPECS_Q = _quantized_layer_specs()
+
+
+def bert_param_specs(tp: bool = True, quantized: bool = False) -> dict:
     """PartitionSpec pytree matching models.bert param layout."""
+    base = _TP_LAYER_SPECS_Q if quantized else _TP_LAYER_SPECS
     layer = (
-        _TP_LAYER_SPECS
+        base
         if tp
         else {
             name: {k: P(None) for k in leaf}
-            for name, leaf in _TP_LAYER_SPECS.items()
+            for name, leaf in base.items()
         }
     )
     return {
@@ -62,7 +88,11 @@ def bert_param_specs(tp: bool = True) -> dict:
 
 def shard_bert_params(params: dict, mesh: Mesh, tp: bool = True) -> dict:
     """Place a bert param pytree on the mesh with the TP layout."""
-    specs = bert_param_specs(tp=tp and mesh.shape.get("tp", 1) > 1)
+    from ..models.quant import is_quantized
+
+    specs = bert_param_specs(
+        tp=tp and mesh.shape.get("tp", 1) > 1, quantized=is_quantized(params)
+    )
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         params,

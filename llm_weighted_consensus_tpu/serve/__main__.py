@@ -295,6 +295,7 @@ def build_embedder(config: Config, allow_synthetic: bool = False):
             else None
         ),
         max_tokens=max_tokens,
+        quantize=config.embedder_quantize,
     )
     from ..models.tokenizer import HashTokenizer
 

@@ -16,6 +16,9 @@ TPU additions:
 * ``EMBEDDER_VOCAB``  — path to a WordPiece ``vocab.txt``; defaults to
   the vocab.txt beside EMBEDDER_WEIGHTS when present, else hash-tokenizer
   fallback.
+* ``EMBEDDER_QUANTIZE`` — ``int8`` serves the encoder W8A8 on the MXU's
+  int8 path (2x bf16 peak; opt-in, accuracy pinned in tests/test_quant.py).
+  Default ``none``.
 * ``EMBEDDER_MAX_TOKENS`` — truncation window.  Default: the model's full
   position table under ``MESH_SP`` (long-context serving must not silently
   truncate), else 512.
@@ -143,6 +146,7 @@ class Config:
     embedder_weights: Optional[str] = None  # local checkpoint path
     embedder_vocab: Optional[str] = None  # path to vocab.txt
     embedder_max_tokens: Optional[int] = None  # None = context-aware default
+    embedder_quantize: str = "none"  # "int8" = W8A8 serving (models/quant.py)
     # reward-model re-ranking service (POST /consensus {"scorer": "rm"})
     rm_model: Optional[str] = None  # e.g. "deberta-v3-base"
     rm_weights: Optional[str] = None  # local HF/orbax checkpoint
@@ -220,6 +224,7 @@ class Config:
                 if env.get("EMBEDDER_MAX_TOKENS")
                 else None
             ),
+            embedder_quantize=env.get("EMBEDDER_QUANTIZE") or "none",
             rm_model=env.get("RM_MODEL"),
             rm_weights=env.get("RM_WEIGHTS"),
             rm_vocab=env.get("RM_VOCAB"),

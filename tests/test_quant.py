@@ -1,0 +1,159 @@
+"""int8 (W8A8) serving mode: numerics, plumbing, and sharding.
+
+The quantized path is opt-in (models/quant.py, ``quantize="int8"``) and
+has no reference analog (the reference's model compute is upstream HTTP);
+these tests pin what the mode promises: per-matmul quantization error at
+the int8-resolution scale, end-to-end embeddings close to the
+full-precision path, consensus votes that agree with full precision on
+clusterable candidates, and TP-shardability of the quantized pytree.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from llm_weighted_consensus_tpu.models import bert, configs
+from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+from llm_weighted_consensus_tpu.models.quant import (
+    dense_int8,
+    quantize_bert_params,
+    quantize_weight,
+)
+
+TINY = configs.TEST_TINY
+
+
+def test_quantize_weight_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 32)) * 0.1, jnp.float32)
+    q, scale = quantize_weight(w)
+    assert q.dtype == jnp.int8 and scale.shape == (32,)
+    deq = np.asarray(q, np.float32) * np.asarray(scale)[None, :]
+    # symmetric int8 round-off: half a step of each channel's scale
+    err = np.abs(deq - np.asarray(w))
+    assert (err <= np.asarray(scale)[None, :] * 0.5 + 1e-9).all()
+
+
+def test_dense_int8_matches_f32_dense():
+    from llm_weighted_consensus_tpu.models.layers import dense
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 48)), jnp.float32)
+    p = {
+        "kernel": jnp.asarray(rng.standard_normal((48, 24)) * 0.2, jnp.float32),
+        "bias": jnp.asarray(rng.standard_normal(24) * 0.1, jnp.float32),
+    }
+    kq, scale = quantize_weight(p["kernel"])
+    out_q = np.asarray(dense_int8(x, {"kernel_q": kq, "scale": scale, "bias": p["bias"]}))
+    out_f = np.asarray(dense(x, p))
+    # W8A8 error scale: ~1/127 relative per factor; contraction over 48
+    # terms averages it out
+    denom = np.abs(out_f).max()
+    assert np.abs(out_q - out_f).max() / denom < 0.03
+
+
+def test_quantized_forward_tracks_full_precision():
+    params = bert.init_params(jax.random.PRNGKey(0), TINY)
+    qparams = quantize_bert_params(params)
+    import dataclasses
+
+    qcfg = dataclasses.replace(TINY, quantize="int8")
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(3, TINY.vocab_size, (4, 16)), jnp.int32)
+    mask = jnp.ones((4, 16), jnp.int32)
+    full = np.asarray(bert.embed(params, ids, mask, TINY))
+    quant = np.asarray(bert.embed(qparams, ids, mask, qcfg))
+    # l2-normalized embeddings: cosine similarity is the honest metric
+    cos = (full * quant).sum(axis=1)
+    assert cos.min() > 0.98, cos
+
+
+def test_quantized_embedder_vote_agrees_with_full_precision():
+    kwargs = dict(config=TINY, max_tokens=32, seed=3)
+    full = TpuEmbedder("test-tiny", **kwargs)
+    quant = TpuEmbedder("test-tiny", quantize="int8", **kwargs)
+    assert quant.config.quantize == "int8"
+    assert "kernel_q" in quant.params["layers"]["attn_q"]
+    texts = [
+        "the answer is four",
+        "the answer is four",
+        "the answer is four!",
+        "bananas and poetry 999",
+    ]
+    cf = np.asarray(full.consensus_confidence(texts))
+    cq = np.asarray(quant.consensus_confidence(texts))
+    assert cf.argmax() == cq.argmax()
+    assert abs(float(cq.sum()) - 1.0) < 1e-3
+    # distribution stays close, not just the argmax
+    assert np.abs(cf - cq).max() < 0.1, (cf, cq)
+
+
+def test_quantized_golden_checkpoint_vote_agreement():
+    """The committed HF-snapshot golden checkpoint through both paths:
+    real weights, real tokenizer — quantization must preserve the vote."""
+    import os
+
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "bge_micro"
+    )
+    if not os.path.isdir(fixture):
+        pytest.skip("golden checkpoint fixture missing")
+    import json
+
+    from llm_weighted_consensus_tpu.models.loading import (
+        find_vocab,
+        load_params,
+    )
+    from llm_weighted_consensus_tpu.models.tokenizer import load_tokenizer
+
+    with open(os.path.join(fixture, "config.json")) as f:
+        cfg = json.load(f)
+    config = configs.BertConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=cfg["num_attention_heads"],
+        intermediate_size=cfg["intermediate_size"],
+        max_position_embeddings=cfg["max_position_embeddings"],
+        type_vocab_size=cfg["type_vocab_size"],
+        layer_norm_eps=cfg["layer_norm_eps"],
+    )
+    params = load_params(fixture, config)
+    tok = load_tokenizer(find_vocab(fixture))
+    kwargs = dict(config=config, tokenizer=tok, max_tokens=64)
+    full = TpuEmbedder("bge-micro", params=params, **kwargs)
+    quant = TpuEmbedder(
+        "bge-micro", params=params, quantize="int8", **kwargs
+    )
+    texts = [
+        "paris is the capital of france",
+        "the capital of france is paris",
+        "paris, france's capital city",
+        "bananas are curved and yellow",
+    ]
+    cf = np.asarray(full.consensus_confidence(texts))
+    cq = np.asarray(quant.consensus_confidence(texts))
+    assert cf.argmax() == cq.argmax()
+    assert np.abs(cf - cq).max() < 0.1, (cf, cq)
+
+
+def test_quantized_params_shard_on_dp_tp_mesh():
+    from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
+    from llm_weighted_consensus_tpu.parallel.sharding import shard_embedder
+
+    n = min(len(jax.devices()), 4)
+    if n < 4:
+        pytest.skip("needs 4 virtual devices")
+    emb = TpuEmbedder(
+        "test-tiny", config=TINY, max_tokens=32, seed=3, quantize="int8"
+    )
+    ref = TpuEmbedder("test-tiny", config=TINY, max_tokens=32, seed=3,
+                      quantize="int8")
+    texts = ["alpha one", "alpha one", "beta two", "gamma three"]
+    want = np.asarray(ref.consensus_confidence(texts))
+    mesh = make_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+    shard_embedder(emb, mesh, tp=True)
+    got = np.asarray(emb.consensus_confidence(texts))
+    np.testing.assert_allclose(got, want, atol=2e-4)

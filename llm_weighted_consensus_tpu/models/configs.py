@@ -174,6 +174,10 @@ class DebertaConfig:
     position_buckets: int = 256  # 0 = clamp scheme
     layer_norm_eps: float = 1e-7
     pad_token_id: int = 0
+    # "int8": W8A8 content/MLP matmuls (models/quant.py twin for the RM;
+    # the tiny positional projections and the reward head stay full
+    # precision).  Opt-in, accuracy pinned in tests/test_quant.py.
+    quantize: str = "none"
 
     @property
     def head_dim(self) -> int:

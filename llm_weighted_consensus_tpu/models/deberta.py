@@ -30,7 +30,14 @@ import jax
 import jax.numpy as jnp
 
 from .configs import DebertaConfig
-from .layers import dense as _dense, dense_init as _dense_init, layer_norm as _layer_norm, ln_init as _ln_init
+from .layers import (
+    dense as _dense,
+    dense_cfg as _dense_cfg,
+    dense_init as _dense_init,
+    gelu_erf as _gelu_erf,
+    layer_norm as _layer_norm,
+    ln_init as _ln_init,
+)
 
 
 def init_params(rng, config: DebertaConfig, dtype=jnp.float32) -> dict:
@@ -108,42 +115,51 @@ def _disentangled_attention(x, rel, p, mask_bias, config: DebertaConfig):
     nh, hd = config.num_heads, config.head_dim
     k = config.att_span
 
-    q_c = _dense(x, p["attn_q"]).reshape(b, s, nh, hd)
-    k_c = _dense(x, p["attn_k"]).reshape(b, s, nh, hd)
-    v = _dense(x, p["attn_v"]).reshape(b, s, nh, hd)
-    # relative projections of the shared table: [2k, nh, hd]
+    q_c = _dense_cfg(x, p["attn_q"], config).reshape(b, s, nh, hd)
+    k_c = _dense_cfg(x, p["attn_k"], config).reshape(b, s, nh, hd)
+    v = _dense_cfg(x, p["attn_v"], config).reshape(b, s, nh, hd)
+    # relative projections of the shared table: [2k, nh, hd] — always
+    # full precision: one tiny matmul per forward, position-sensitive
     q_r = _dense(rel, p["pos_q"]).reshape(2 * k, nh, hd)
     k_r = _dense(rel, p["pos_k"]).reshape(2 * k, nh, hd)
 
     rel_idx = _rel_index(s, config)  # [s, s]
 
+    # The three disentangled score tensors store in the activation dtype
+    # (f32 MXU accumulation unchanged) like every other matmul in the
+    # model family — on the bf16 TPU path that halves the HBM traffic of
+    # THREE [b, nh, s, s] intermediates + two bucket gathers (the same
+    # r4 cut measured on bert.py's single logits tensor); the f32 parity
+    # path is byte-identical.  Softmax stays f32 per the module contract.
     # content -> content
     c2c = jnp.einsum(
-        "bqnd,bknd->bnqk", q_c, k_c, preferred_element_type=jnp.float32
+        "bqnd,bknd->bnqk", q_c, k_c, preferred_element_type=x.dtype
     )
     # content -> position: q_c against every bucket, then gather per (i, j)
     c2p_all = jnp.einsum(
-        "bqnd,rnd->bnqr", q_c, k_r, preferred_element_type=jnp.float32
+        "bqnd,rnd->bnqr", q_c, k_r, preferred_element_type=x.dtype
     )  # [b, nh, s, 2k]
     c2p = jnp.take_along_axis(
         c2p_all, rel_idx[None, None, :, :], axis=-1
     )  # [b, nh, s, s]
     # position -> content: k_c against every bucket, transposed gather
     p2c_all = jnp.einsum(
-        "bknd,rnd->bnkr", k_c, q_r, preferred_element_type=jnp.float32
+        "bknd,rnd->bnkr", k_c, q_r, preferred_element_type=x.dtype
     )  # [b, nh, s, 2k]
     p2c = jnp.take_along_axis(
         p2c_all, rel_idx.T[None, None, :, :], axis=-1
     )  # [b, nh, k_pos=s, q_pos=s] -> transpose to [b, nh, q, k]
     p2c = jnp.swapaxes(p2c, -1, -2)
 
-    scale = 1.0 / jnp.sqrt(jnp.float32(3 * hd))
-    logits = (c2c + c2p + p2c) * scale + mask_bias
+    # python-float scale + same-dtype bias keep the sum in x.dtype (an
+    # f32 scalar would silently promote all three tensors back to f32)
+    scale = 1.0 / float(3 * hd) ** 0.5
+    logits = (c2c + c2p + p2c) * scale + mask_bias.astype(x.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
     ctx = jnp.einsum(
         "bnqk,bknd->bqnd", probs, v, preferred_element_type=jnp.float32
     ).astype(x.dtype)
-    return _dense(ctx.reshape(b, s, h), p["attn_out"])
+    return _dense_cfg(ctx.reshape(b, s, h), p["attn_out"], config)
 
 
 def encode(
@@ -164,7 +180,15 @@ def encode(
     def body(carry, layer_p):
         attn = _disentangled_attention(carry, rel, layer_p, mask_bias, config)
         y = _layer_norm(carry + attn, layer_p["attn_ln"], config.layer_norm_eps)
-        mlp = _dense(jax.nn.gelu(_dense(y, layer_p["mlp_in"])), layer_p["mlp_out"])
+        # exact-erf GELU (bert._gelu_erf: exact for f32, A&S for bf16):
+        # HF deberta-v2's hidden_act is "gelu" = erf — jax.nn.gelu's
+        # default tanh approximation silently diverged here (r4 fix; the
+        # head below already used approximate=False)
+        mlp = _dense_cfg(
+            _gelu_erf(_dense_cfg(y, layer_p["mlp_in"], config)),
+            layer_p["mlp_out"],
+            config,
+        )
         return _layer_norm(y + mlp, layer_p["mlp_ln"], config.layer_norm_eps), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])

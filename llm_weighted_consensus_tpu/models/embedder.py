@@ -189,16 +189,8 @@ class TpuEmbedder:
     ) -> None:
         from .configs import usable_positions
 
-        if quantize not in ("none", "int8"):
-            raise ValueError(
-                f"quantize={quantize!r}: expected 'none' or 'int8'"
-            )
         self.model_name = model
         self.config = config or PRESETS[model]
-        if quantize != "none":
-            import dataclasses
-
-            self.config = dataclasses.replace(self.config, quantize=quantize)
         self.max_tokens = min(max_tokens, usable_positions(self.config))
         # family default from the config (bge: CLS, e5/gte: masked mean)
         # unless the caller overrides
@@ -215,14 +207,11 @@ class TpuEmbedder:
             params = bert.init_params(
                 jax.random.PRNGKey(seed), self.config, dtype=dtype
             )
-        if quantize == "int8":
-            # quantize ONCE at load: full-precision checkpoints (or the
-            # random init above) become the W8A8 twin here; callers may
-            # also pass pre-quantized params directly
-            from .quant import is_quantized, quantize_bert_params
+        # validate + stamp the quantize mode and (once, at load) quantize
+        # full-precision params — the shared entry point with TpuReranker
+        from .quant import resolve_quantize
 
-            if not is_quantized(params):
-                params = quantize_bert_params(params)
+        self.config, params = resolve_quantize(self.config, params, quantize)
         self.params = params
         self.put_batch = lambda ids, mask: (ids, mask)  # mesh hook
         # batches are padded up to a multiple of this before dispatch so

@@ -51,3 +51,55 @@ def dense(x, p: dict):
         ).astype(x.dtype)
         + p["bias"]
     )
+
+
+def dense_cfg(x, p: dict, config):
+    """The layer-dense op under the config's quantize mode: param-dtype
+    matmul (dense above) or the W8A8 int8-MXU twin (models/quant.py) —
+    selected statically by ``config.quantize``, so the jit sees one path.
+    Shared by every model family (bert, deberta)."""
+    if config.quantize == "int8":
+        from .quant import dense_int8
+
+        return dense_int8(x, p)
+    return dense(x, p)
+
+
+def gelu_erf(x: jax.Array) -> jax.Array:
+    """Exact (erf) GELU: HF BERT/bge/deberta checkpoints use
+    hidden_act="gelu", which is erf-based — jax.nn.gelu's default tanh
+    approximation would silently diverge from real checkpoints
+    (tests/test_hf_parity.py): its output differs from exact-erf GELU by
+    up to 257 bf16 ulps and flips the bf16 rounding of ~40% of inputs
+    (measured, r4).
+
+    f32 inputs always take XLA's exact erf; upcast from bf16 would too
+    be exact — but for bf16 activations the erf lowering's ~12-op
+    polynomial is the single largest non-matmul cost in the encoder
+    forward (~2.7 ms of the 33.5 ms bge-large N=64/s=128 forward,
+    bench_fwd.py).  The bf16 path instead uses the Abramowitz-Stegun
+    7.1.26 erfc form, which rides the TPU's hardware exp: design error
+    2.2e-7 absolute (f64), and after bf16 rounding it agrees with the
+    exact-erf f32 GELU to <=1 bf16 ulp on ALL finite bf16 inputs
+    x >= -3 (<2% of them flip by that 1 ulp — inherent to any f32
+    evaluation near rounding midpoints) and to 2e-5 absolute in the deep
+    tail (|gelu| < 0.005, where f32 cancellation in the polynomial
+    shows).  Asserted exhaustively over every finite bf16 input in
+    tests/test_models.py."""
+    x32 = x.astype(jnp.float32)
+    if x.dtype != jnp.bfloat16:
+        out = x32 * 0.5 * (1.0 + jax.lax.erf(x32 * (2.0 ** -0.5)))
+        return out.astype(x.dtype)
+    z = jnp.abs(x32) * (2.0 ** -0.5)
+    t = 1.0 / (1.0 + 0.3275911 * z)
+    poly = t * (
+        0.254829592
+        + t
+        * (
+            -0.284496736
+            + t * (1.421413741 + t * (-1.453152027 + t * 1.061405429))
+        )
+    )
+    half_erfc = 0.5 * poly * jnp.exp(-z * z)
+    phi = jnp.where(x32 > 0, 1.0 - half_erfc, half_erfc)
+    return (x32 * phi).astype(x.dtype)

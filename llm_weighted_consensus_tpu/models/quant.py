@@ -102,3 +102,30 @@ def quantize_bert_params(params: dict) -> dict:
     out = dict(params)
     out["layers"] = layers
     return out
+
+
+# DeBERTa RM: the same six content/MLP kernels quantize; the positional
+# projections (pos_q/pos_k — one tiny [2k, h] matmul per forward, and the
+# disentangled scores are position-sensitive) and the reward head (two
+# small matmuls whose scalar output IS the product) stay full precision.
+quantize_deberta_params = quantize_bert_params
+
+
+def resolve_quantize(config, params: dict, quantize: str):
+    """The ONE quantize-mode entry point for model constructors
+    (TpuEmbedder, TpuReranker): validates the mode, stamps it on the
+    (frozen dataclass) config, and quantizes full-precision params once
+    at load — pre-quantized pytrees pass through.  Returns
+    (config, params)."""
+    if quantize not in ("none", "int8"):
+        raise ValueError(
+            f"quantize={quantize!r}: expected 'none' or 'int8'"
+        )
+    if quantize == "none":
+        return config, params
+    import dataclasses
+
+    config = dataclasses.replace(config, quantize=quantize)
+    if not is_quantized(params):
+        params = quantize_bert_params(params)
+    return config, params

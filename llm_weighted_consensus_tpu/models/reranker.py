@@ -55,6 +55,7 @@ class TpuReranker:
         dtype=None,
         max_tokens: int = 512,
         seed: int = 0,
+        quantize: str = "none",
     ) -> None:
         self.model_name = model
         self.config = config or RM_PRESETS[model]
@@ -71,6 +72,10 @@ class TpuReranker:
             params = deberta.init_params(
                 jax.random.PRNGKey(seed), self.config, dtype=dtype
             )
+        # shared quantize entry point with TpuEmbedder (models/quant.py)
+        from .quant import resolve_quantize
+
+        self.config, params = resolve_quantize(self.config, params, quantize)
         self.params = params
 
     def tokenize(self, texts: Iterable[str]):

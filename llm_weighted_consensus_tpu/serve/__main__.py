@@ -407,6 +407,7 @@ def build_reranker(config: Config, allow_synthetic: bool = False):
             else None
         ),
         max_tokens=config.rm_max_tokens,
+        quantize=config.rm_quantize,
     )
     synthetic = []
     if params is None:

@@ -40,7 +40,8 @@ TPU additions:
 * ``PROFILE_DIR`` — arms ``POST /profile/start`` / ``POST /profile/stop``:
   JAX profiler traces (xprof format, viewable in TensorBoard/xprof) are
   written under this directory.  Unset = endpoints disabled (404).
-* ``RM_MODEL`` / ``RM_WEIGHTS`` / ``RM_VOCAB`` / ``RM_MAX_TOKENS`` — a
+* ``RM_MODEL`` / ``RM_WEIGHTS`` / ``RM_VOCAB`` / ``RM_MAX_TOKENS`` /
+  ``RM_QUANTIZE`` (``int8`` = W8A8 RM serving, default ``none``) — a
   DeBERTa reward model serving ``POST /consensus {"scorer": "rm"}``
   (BASELINE config 3 as a service): candidates re-rank by
   softmax(reward).  Same synthetic-params gate as the embedder; real
@@ -152,6 +153,7 @@ class Config:
     rm_weights: Optional[str] = None  # local HF/orbax checkpoint
     rm_vocab: Optional[str] = None  # spm.model / vocab.txt
     rm_max_tokens: int = 512
+    rm_quantize: str = "none"  # "int8" = W8A8 RM serving (models/quant.py)
     mesh_dp: Optional[int] = None
     mesh_tp: int = 1
     mesh_sp: Optional[int] = None
@@ -229,6 +231,7 @@ class Config:
             rm_weights=env.get("RM_WEIGHTS"),
             rm_vocab=env.get("RM_VOCAB"),
             rm_max_tokens=int(env.get("RM_MAX_TOKENS", 512)),
+            rm_quantize=env.get("RM_QUANTIZE") or "none",
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
             mesh_tp=int(env.get("MESH_TP", 1)),
             mesh_sp=int(env["MESH_SP"]) if env.get("MESH_SP") else None,

@@ -139,6 +139,30 @@ def test_quantized_golden_checkpoint_vote_agreement():
     assert np.abs(cf - cq).max() < 0.1, (cf, cq)
 
 
+def test_quantized_reranker_preserves_reward_ordering():
+    """The int8 RM must keep the reward ORDER (what re-ranking consumes)
+    and a close softmax distribution vs the full-precision path."""
+    from llm_weighted_consensus_tpu.models.reranker import TpuReranker
+
+    kwargs = dict(config=configs.DEBERTA_TEST_TINY, max_tokens=48, seed=5)
+    full = TpuReranker("deberta-test-tiny", **kwargs)
+    quant = TpuReranker("deberta-test-tiny", quantize="int8", **kwargs)
+    assert quant.config.quantize == "int8"
+    # positional projections stay full precision by design
+    assert "kernel" in quant.params["layers"]["pos_q"]
+    assert "kernel_q" in quant.params["layers"]["attn_q"]
+    texts = [
+        "the answer is four because two plus two",
+        "the answer is five because arithmetic",
+        "completely unrelated text about weather",
+    ]
+    cf, tf = full.rerank_confidence(texts, prompt="what is 2+2?")
+    cq, tq = quant.rerank_confidence(texts, prompt="what is 2+2?")
+    assert tf == tq
+    assert list(np.argsort(cf)) == list(np.argsort(cq)), (cf, cq)
+    assert np.abs(cf - cq).max() < 0.1, (cf, cq)
+
+
 def test_quantized_params_shard_on_dp_tp_mesh():
     from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
     from llm_weighted_consensus_tpu.parallel.sharding import shard_embedder

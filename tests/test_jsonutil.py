@@ -1,0 +1,108 @@
+"""jsonutil: the canonical Decimal-exact writer and its stdlib fast path.
+
+The fast path (Decimal-free payloads ride C-accelerated ``json.dumps``)
+must be byte-identical to the exact writer — identity ids are hashes of
+this output (identity/__init__.py), so a single divergent byte would
+silently fork the id space.
+"""
+
+import json
+import math
+import random
+import string
+from decimal import Decimal
+
+from llm_weighted_consensus_tpu.utils import jsonutil
+
+
+def test_fast_path_identical_to_exact_writer_fuzz():
+    rng = random.Random(7)
+    alphabet = string.printable + "éüñØ漢字\x00\x07\x1f\\\""
+
+    def rand_value(depth=0):
+        kind = rng.randrange(8 if depth < 3 else 5)
+        if kind == 0:
+            return None
+        if kind == 1:
+            return rng.choice([True, False])
+        if kind == 2:
+            return rng.randrange(-(10**9), 10**9)
+        if kind == 3:
+            return rng.uniform(-1e6, 1e6)
+        if kind == 4:
+            return "".join(
+                rng.choice(alphabet) for _ in range(rng.randrange(0, 40))
+            )
+        if kind == 5:
+            return [rand_value(depth + 1) for _ in range(rng.randrange(0, 6))]
+        if kind == 6:
+            return {
+                f"k{i}": rand_value(depth + 1)
+                for i in range(rng.randrange(0, 6))
+            }
+        return rng.choice([0.0, -0.0, 1e-300, 1e300, 123456789.123456])
+
+    for _ in range(300):
+        obj = rand_value()
+        ours = jsonutil.dumps(obj)
+        std = json.dumps(
+            obj, separators=(",", ":"), ensure_ascii=False, allow_nan=False
+        )
+        assert ours == std, (obj, ours, std)
+        # and the slow writer agrees too (the identity contract)
+        slow: list = []
+        jsonutil._write_compact(obj, slow)
+        assert "".join(slow) == std, obj
+
+
+def test_float_subclasses_format_identically_on_both_paths():
+    """np.float64 under numpy>=2 reprs as 'np.float64(1.5)'; both the
+    stdlib fast path and the exact writer must emit the plain float
+    form regardless of Decimal presence elsewhere in the payload."""
+    np = __import__("numpy")
+    fast = jsonutil.dumps({"x": np.float64(1.5)})
+    slow = jsonutil.dumps({"x": np.float64(1.5), "d": Decimal("1.0")})
+    assert fast == '{"x":1.5}'
+    assert slow == '{"x":1.5,"d":1.0}'
+
+
+def test_decimal_payloads_take_the_exact_writer():
+    obj = {"w": Decimal("1.50"), "xs": [Decimal("0.1"), 2, "x"]}
+    assert jsonutil.dumps(obj) == '{"w":1.50,"xs":[0.1,2,"x"]}'
+    # trailing zeros preserved verbatim — the reason the writer exists
+    assert jsonutil.dumps(Decimal("2.000")) == "2.000"
+
+
+def test_decimal_deep_in_large_payload_still_exact():
+    obj = {"pad": [float(i) for i in range(1000)], "d": Decimal("0.30")}
+    out = jsonutil.dumps(obj)
+    assert out.endswith('"d":0.30}')
+
+
+def test_non_finite_rejected_on_both_paths():
+    for bad in (float("nan"), float("inf"), -float("inf")):
+        for obj in (bad, {"x": bad}, [1.0, bad]):
+            try:
+                jsonutil.dumps(obj)
+            except ValueError:
+                continue
+            raise AssertionError(f"{obj} did not raise")
+    try:
+        jsonutil.dumps(Decimal("NaN"))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("Decimal NaN did not raise")
+
+
+def test_pretty_form_unchanged():
+    assert (
+        jsonutil.dumps({"a": [1, Decimal("1.0")]}, pretty=True)
+        == '{\n  "a": [\n    1,\n    1.0\n  ]\n}'
+    )
+
+
+def test_roundtrip_loads_preserves_decimal():
+    obj = jsonutil.loads('{"x": 1.50, "n": 3}')
+    assert obj["x"] == Decimal("1.50") and isinstance(obj["x"], Decimal)
+    assert math.isclose(float(obj["x"]), 1.5)

@@ -61,7 +61,7 @@ def _percentiles(lat_ms: list) -> dict:
     }
 
 
-async def _start_service(model: str, window_ms: float):
+async def _start_service(model: str, window_ms: float, quantize: str = "none"):
     """The real service on real localhost TCP sockets (fake upstream
     included), exactly as ``python -m ...serve --fake-upstream`` wires it."""
     from aiohttp import web
@@ -78,6 +78,7 @@ async def _start_service(model: str, window_ms: float):
         {
             "EMBEDDER_MODEL": model,
             "BATCH_WINDOW_MS": str(window_ms),
+            "EMBEDDER_QUANTIZE": quantize,
         }
     )
     app = build_service(
@@ -133,7 +134,7 @@ async def _drive(session, url, bodies, concurrency, warmup_bursts=2):
 
 
 async def bench_consensus_endpoint(
-    session, base, embedder, n, requests, concurrency
+    session, base, embedder, n, requests, concurrency, quantize="none"
 ):
     """Served /consensus vs the direct-call twin on identical inputs."""
     reqs = make_requests(requests, n)
@@ -191,6 +192,7 @@ async def bench_consensus_endpoint(
         n_candidates=n,
         requests=len(bodies),
         concurrency=concurrency,
+        quantize=quantize,
         direct_call_answers_per_sec=round(direct_rate, 3),
         served_vs_direct=round(served / direct_rate, 3),
         note=(
@@ -300,7 +302,7 @@ async def main_async(args) -> None:
     import aiohttp
 
     runner, fake_runner, port, embedder = await _start_service(
-        args.model, args.window_ms
+        args.model, args.window_ms, args.quantize
     )
     base = f"http://127.0.0.1:{port}"
     try:
@@ -315,6 +317,7 @@ async def main_async(args) -> None:
                     args.n,
                     args.requests,
                     args.concurrency,
+                    quantize=args.quantize,
                 )
             await bench_score_endpoint(
                 session, base, args.requests, args.concurrency
@@ -338,6 +341,12 @@ def main() -> None:
     except Exception:
         default_model = "test-tiny"
     parser.add_argument("--model", default=default_model)
+    parser.add_argument(
+        "--quantize",
+        choices=("none", "int8"),
+        default="none",
+        help="serve the embedder W8A8 (EMBEDDER_QUANTIZE passthrough)",
+    )
     parser.add_argument("--n", type=int, default=64)
     parser.add_argument("--requests", type=int, default=100)
     parser.add_argument("--concurrency", type=int, default=16)

@@ -68,12 +68,6 @@ def _format_float(f: float) -> str:
     return float.__repr__(f)
 
 
-def _decimal_default(obj):
-    # sentinel hook: any type stdlib json doesn't know (Decimal included)
-    # aborts the fast path so the exact writer takes over
-    raise TypeError(f"not stdlib-serializable: {type(obj)!r}")
-
-
 def dumps(obj, *, pretty: bool = False) -> str:
     """Serialize ``obj`` (dict/list/str/bool/None/int/float/Decimal) to JSON.
 
@@ -87,12 +81,13 @@ def dumps(obj, *, pretty: bool = False) -> str:
     types never produce them and neither path is specified for them."""
     if not pretty:
         try:
+            # stdlib raises TypeError on any type it doesn't know
+            # (Decimal included) — no default hook needed
             return json.dumps(
                 obj,
                 separators=(",", ":"),
                 ensure_ascii=False,
                 allow_nan=False,
-                default=_decimal_default,
             )
         except (TypeError, ValueError):
             # Decimal somewhere (exact writer required), or a non-finite

@@ -218,6 +218,33 @@ def test_reward_consensus_vote(rm_params):
     assert conf[0] > conf[1] > conf[2]
 
 
+# -- sequence bucketing -------------------------------------------------------
+
+
+def test_seq_bucket_multiples_of_16_then_sparse():
+    from llm_weighted_consensus_tpu.models.embedder import _seq_bucket
+
+    assert _seq_bucket(1, 512) == 16
+    assert _seq_bucket(100, 512) == 112  # the ~100-token serving case
+    assert _seq_bucket(112, 512) == 112
+    assert _seq_bucket(113, 512) == 128
+    assert _seq_bucket(130, 512) == 192
+    assert _seq_bucket(500, 512) == 512
+    # caps at the window
+    assert _seq_bucket(100, 64) == 64
+    # long-context presets keep doubling (bounded jit specializations)
+    assert _seq_bucket(600, 8192) == 1024
+    assert _seq_bucket(5000, 8192) == 8192
+
+
+def test_tokenize_lands_in_seq_bucket():
+    emb = TpuEmbedder("test-tiny", config=TINY, max_tokens=128, seed=1)
+    # ~20 tokens -> the 32 bucket, not 128
+    ids, mask = emb.tokenize(["word " * 20])
+    assert ids.shape[1] in (32, 48)  # tokenizer-dependent, never 128
+    assert ids.shape == mask.shape
+
+
 # -- GELU numerics ------------------------------------------------------------
 
 

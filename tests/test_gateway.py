@@ -504,6 +504,32 @@ def test_consensus_endpoint_round_trip():
     go(with_client(app, run))
 
 
+def test_consensus_endpoint_serves_quantized_embedder():
+    """EMBEDDER_QUANTIZE=int8 end to end: the served vote distribution
+    must track the full-precision serving path on the same inputs."""
+    pytest.importorskip("jax")
+    import numpy as np
+
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    texts = ["the answer is 42", "the answer is 42!", "cabbage soup 99"]
+    results = {}
+    for mode in ("none", "int8"):
+        app, _ = make_app(
+            [], embedder=TpuEmbedder("test-tiny", max_tokens=32, quantize=mode)
+        )
+
+        async def run(client):
+            resp = await post_json(client, "/consensus", {"input": texts})
+            assert resp.status == 200
+            results[mode] = (await resp.json())["confidence"]
+
+        go(with_client(app, run))
+    full, quant = np.asarray(results["none"]), np.asarray(results["int8"])
+    assert full.argmax() == quant.argmax()
+    assert np.abs(full - quant).max() < 0.1
+
+
 def test_consensus_endpoint_validation():
     pytest.importorskip("jax")
     app, _ = make_app([], embedder=_tiny_embedder())

@@ -526,6 +526,42 @@ class _ArchivingClient:
                 )
 
 
+def _warmup_embedder(embedder, specs: list) -> None:
+    """Pre-compile the consensus path for the given ``NxS`` shapes at
+    startup (WARMUP env, serve/config.py) so the first real request
+    doesn't pay a multi-second jit compile.  Each spec warms the
+    single-request dispatch at exactly that (candidate count, seq
+    bucket); invalid specs fail startup loudly (a silently skipped
+    warmup defeats its purpose).  S snaps to the serving seq bucket the
+    tokenizer would pick, so the compiled shape is the one traffic
+    actually hits."""
+    import logging
+    import time as _time
+
+    import numpy as np
+
+    from ..models.embedder import _seq_bucket
+
+    log = logging.getLogger("lwc.serve")
+    # dedup AFTER bucket snapping: 64x100 and 64x112 are the same
+    # compiled shape, and a second dispatch of it is pure wasted startup
+    snapped = list(
+        dict.fromkeys(
+            (n, _seq_bucket(s, embedder.max_tokens)) for n, s in specs
+        )
+    )
+    for n, s in snapped:
+        ids = np.zeros((n, s), dtype=np.int32)
+        mask = np.zeros((n, s), dtype=np.int32)
+        mask[:, 0] = 1  # one real token per row: a clean forward
+        t0 = _time.perf_counter()
+        np.asarray(embedder.consensus_confidence_tokens(ids, mask))
+        log.info(
+            "warmup %dx%d compiled in %.1fs",
+            n, s, _time.perf_counter() - t0,
+        )
+
+
 def build_service(
     config: Config,
     fake_upstream: bool = False,
@@ -575,6 +611,8 @@ def build_service(
     # --fake-upstream is demo/test mode: synthetic embedder params are
     # allowed (still logged); production startup refuses them
     embedder = build_embedder(config, allow_synthetic=fake_upstream)
+    if embedder is not None and config.warmup:
+        _warmup_embedder(embedder, config.warmup)
     reranker = build_reranker(config, allow_synthetic=fake_upstream)
     batcher = None
     metrics = None

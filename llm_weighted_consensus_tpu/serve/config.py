@@ -79,6 +79,11 @@ TPU additions:
 * ``BATCH_PIPELINE`` — device dispatches allowed in flight concurrently
   (the host side of batch k+1 overlaps batch k's device execution).
   Default 2; 1 = fully serialized.
+* ``WARMUP`` — consensus shapes to pre-compile at startup, e.g.
+  ``64x112,64x128`` (``NxS`` pairs): the first request at a shape
+  otherwise pays a multi-second jit compile (each (N, seq-bucket) is
+  its own XLA specialization); pair with ``COMPILE_CACHE_DIR`` to make
+  later restarts near-instant.  Invalid specs fail startup loudly.
 * ``BATCH_MAX_ROWS`` — encoder rows per fused dispatch; a synchronized
   burst of requests chunks into this many rows per dispatch so the
   pipeline has pieces to overlap.  Default 512.
@@ -91,6 +96,38 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..utils import env_truthy, jsonutil
+
+
+def _parse_warmup(raw) -> list:
+    """"64x112,64x128" -> [(64, 112), (64, 128)].  Raises on malformed
+    specs: a silently dropped warmup defeats its purpose."""
+    if not raw:
+        return []
+    out = []
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        from .gateway import MAX_CONSENSUS_CANDIDATES
+
+        try:
+            n_s = part.split("x")
+            n, s = int(n_s[0]), int(n_s[1])
+            if (
+                len(n_s) != 2
+                or not 2 <= n <= MAX_CONSENSUS_CANDIDATES
+                or s < 1
+            ):
+                raise ValueError
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"WARMUP spec {part!r}: expected NxS with 2 <= N <= "
+                f"{MAX_CONSENSUS_CANDIDATES} candidates (the /consensus "
+                "request ceiling — warming an unreachable shape burns "
+                "startup time for nothing) and S >= 1 tokens (e.g. 64x112)"
+            ) from None
+        out.append((n, s))
+    return out
 
 
 def _non_negative_int(env: dict, name: str, default: int) -> int:
@@ -175,6 +212,9 @@ class Config:
     batch_pipeline: int = 2
     # encoder rows per dispatch (bursts chunk into overlappable pieces)
     batch_max_rows: int = 512
+    # [(n_candidates, seq), ...] consensus shapes to pre-compile at
+    # startup (WARMUP env, e.g. "64x112,64x128"); [] = lazy compiles
+    warmup: list = field(default_factory=list)
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -250,6 +290,7 @@ class Config:
             batch_max=int(env.get("BATCH_MAX", 64)),
             batch_pipeline=max(1, int(env.get("BATCH_PIPELINE", 2))),
             batch_max_rows=max(1, int(env.get("BATCH_MAX_ROWS", 512))),
+            warmup=_parse_warmup(env.get("WARMUP")),
         )
 
     def backoff_policy(self):

@@ -326,6 +326,39 @@ def test_config_env_parity():
     assert c.other_chunk_timeout_millis == 60000
 
 
+def test_config_warmup_parsing():
+    c = Config.from_env({"WARMUP": "64x112, 64x128"})
+    assert c.warmup == [(64, 112), (64, 128)]
+    assert Config.from_env({}).warmup == []
+    assert Config.from_env({"WARMUP": ""}).warmup == []
+    import pytest as _pytest
+
+    for bad in (
+        "64x", "x128", "1x16", "64x0", "64x112x3", "sixtyfour",
+        "640x112",  # above the /consensus candidate ceiling: unreachable
+    ):
+        with _pytest.raises(ValueError):
+            Config.from_env({"WARMUP": bad})
+
+
+def test_warmup_compiles_configured_shapes():
+    """WARMUP specs run the consensus path at startup (pre-compile); the
+    warmed embedder then serves those shapes without further tracing."""
+    pytest.importorskip("jax")
+    from llm_weighted_consensus_tpu.serve.__main__ import _warmup_embedder
+
+    embedder = _tiny_embedder()
+    calls = []
+    real = embedder.consensus_confidence_tokens
+    embedder.consensus_confidence_tokens = lambda ids, mask, *a: (
+        calls.append((ids.shape, mask.shape)) or real(ids, mask, *a)
+    )
+    _warmup_embedder(embedder, [(4, 16), (6, 30), (6, 32)])
+    # S snaps to the serving seq bucket (30 -> 32); specs that collapse
+    # to the same compiled shape dedup (6x30 == 6x32 -> one dispatch)
+    assert calls == [((4, 16), (4, 16)), ((6, 32), (6, 32))]
+
+
 def test_config_single_api_base_fallback():
     c = Config.from_env({"OPENAI_API_BASE": "https://x", "OPENAI_API_KEY": "s"})
     assert [a.api_key for a in c.api_bases()] == ["s"]

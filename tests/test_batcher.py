@@ -154,6 +154,89 @@ def test_consensus_mixed_shapes_split_groups(embedder):
     assert metrics.snapshot()["series"]["device:batch:consensus"]["count"] == 2
 
 
+def test_chaos_concurrent_mixed_work_matches_direct_twins(embedder):
+    """Fuzz the batcher with a random mix of concurrent embed/consensus/
+    stream work (random sizes, shapes, interleavings): whatever grouping,
+    chunking, bucketing, and pipelining happens inside, every caller must
+    get exactly what a direct unbatched call returns.  This is the net
+    under the r4 pow2-chunking change and any future grouping policy."""
+    import jax.numpy as jnp
+    import random
+
+    rng = random.Random(11)
+    metrics = Metrics()
+    batcher = DeviceBatcher(
+        embedder, metrics, window_ms=5.0, max_batch=7, max_rows=40,
+        pipeline_depth=2,
+    )
+    hidden = embedder.config.hidden_size
+
+    jobs = []
+    for i in range(24):
+        kind = rng.choice(["embed", "consensus", "stream"])
+        if kind == "embed":
+            texts = [f"text {i} {j} {rng.randrange(5)}" for j in range(rng.randrange(1, 5))]
+            jobs.append(("embed", texts))
+        elif kind == "consensus":
+            n = rng.randrange(2, 6)
+            texts = [f"candidate {i % 3} {j % n}" for j in range(n)]
+            jobs.append(("consensus", texts))
+        else:
+            cap = 16
+            buf = jnp.zeros((cap, hidden), jnp.float32)
+            valid = jnp.zeros((cap,), jnp.float32)
+            jobs.append(("stream", (f"stream text {i}", buf, valid, i % cap)))
+
+    async def run():
+        async def one(job):
+            kind, payload = job
+            if kind == "embed":
+                return await batcher.embed(payload)
+            if kind == "consensus":
+                return await batcher.consensus(payload)
+            text, buf, valid, pos = payload
+            return await batcher.stream_update(
+                text, jnp.array(buf), jnp.array(valid), pos
+            )
+
+        async def staggered(j, job):
+            # random sub-window stagger so groups form at many sizes
+            await asyncio.sleep(rng.random() * 0.01)
+            return await one(job)
+
+        return await asyncio.gather(
+            *(staggered(j, job) for j, job in enumerate(jobs))
+        )
+
+    results = go(run())
+
+    for job, result in zip(jobs, results):
+        kind, payload = job
+        if kind == "embed":
+            emb, tokens = result
+            ref = embedder.embed_texts(list(payload))
+            np.testing.assert_allclose(np.asarray(emb), ref, atol=1e-5)
+            assert tokens == embedder.token_count(list(payload))
+        elif kind == "consensus":
+            conf, tokens = result
+            ref = np.asarray(embedder.consensus_confidence(list(payload)))
+            np.testing.assert_allclose(np.asarray(conf), ref, atol=1e-5)
+        else:
+            text, buf, valid, pos = payload
+            out_buf, out_valid, conf = result
+            rb, rv, rc = embedder.stream_vote_update(
+                text, jnp.array(buf), jnp.array(valid), pos
+            )
+            np.testing.assert_allclose(
+                np.asarray(out_buf), np.asarray(rb), atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(conf), np.asarray(rc), atol=1e-5
+            )
+    util = metrics.snapshot()["device_batcher"]
+    assert util["items"] == len(jobs)
+
+
 def test_stream_updates_batch_across_streams(embedder):
     import jax.numpy as jnp
 

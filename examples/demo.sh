@@ -27,6 +27,7 @@ if [ -z "${LWC_DEMO_PLATFORM:-}" ]; then
 fi
 JAX_PLATFORMS="${LWC_DEMO_PLATFORM:-cpu}" \
 EMBEDDER_MODEL=test-tiny EMBEDDER_MAX_TOKENS=32 \
+WARMUP=3x16 \
 RM_MODEL=deberta-test-tiny RM_MAX_TOKENS=32 \
 ARCHIVE_PATH="$WORK/archive.json" TABLES_PATH="$WORK/tables.npz" \
 PROFILE_DIR="$WORK/traces" \

@@ -79,14 +79,16 @@ class TpuReranker:
         self.params = params
 
     def tokenize(self, texts: Iterable[str]):
-        from .embedder import _bucket
+        from .embedder import _seq_bucket
 
         ids, mask = self.tokenizer.encode_batch(
             list(texts), self.max_tokens
         )
         # shrink to the content bucket like the embedder (bounds jit
-        # specializations per (N, S-bucket))
-        seq = _bucket(int(mask.sum(axis=1).max(initial=1)), self.max_tokens)
+        # specializations per (N, S-bucket)); multiples-of-16 buckets so
+        # ~100-token (prompt + candidate) pairs stop paying pow2 padding
+        # (the r4 serving-path cut, embedder._SEQ_BUCKETS)
+        seq = _seq_bucket(int(mask.sum(axis=1).max(initial=1)), self.max_tokens)
         return ids[:, :seq], mask[:, :seq]
 
     def rerank_confidence(

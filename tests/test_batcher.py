@@ -221,6 +221,7 @@ def test_chaos_concurrent_mixed_work_matches_direct_twins(embedder):
             conf, tokens = result
             ref = np.asarray(embedder.consensus_confidence(list(payload)))
             np.testing.assert_allclose(np.asarray(conf), ref, atol=1e-5)
+            assert tokens == embedder.token_count(list(payload))
         else:
             text, buf, valid, pos = payload
             out_buf, out_valid, conf = result
@@ -229,6 +230,9 @@ def test_chaos_concurrent_mixed_work_matches_direct_twins(embedder):
             )
             np.testing.assert_allclose(
                 np.asarray(out_buf), np.asarray(rb), atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(out_valid), np.asarray(rv), atol=1e-5
             )
             np.testing.assert_allclose(
                 np.asarray(conf), np.asarray(rc), atol=1e-5

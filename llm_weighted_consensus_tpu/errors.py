@@ -44,7 +44,17 @@ def to_response_error(err) -> ResponseError:
         return err
     if isinstance(err, StatusError):
         return err.to_response_error()
-    return ResponseError(code=500, message=str(err))
+    # Unexpected (non-taxonomy) exception reaching a client-visible
+    # surface — mid-stream SSE error frames, per-judge error choices:
+    # uniform envelope only, detail to the server log (the reference's
+    # envelope, src/error.rs:8-13, never echoes internals; neither do we).
+    import logging
+
+    logging.getLogger("lwc").error(
+        "unexpected error folded into response envelope",
+        exc_info=err if isinstance(err, BaseException) else None,
+    )
+    return ResponseError(code=500, message="internal error")
 
 
 # ---------------------------------------------------------------------------

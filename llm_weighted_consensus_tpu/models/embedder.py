@@ -325,10 +325,10 @@ class TpuEmbedder:
         recompile the full encoder per distinct concurrency level (tens
         of seconds each for bge-large).  Pad request slots attend to one
         [PAD] token; their confidences are sliced off."""
+        from ..utils import next_pow2
+
         r, n, s = ids.shape
-        r_bucket = 1
-        while r_bucket < r:
-            r_bucket *= 2
+        r_bucket = next_pow2(r)
         if r_bucket != r:
             pad = (r_bucket - r) * n
             ids = np.concatenate(

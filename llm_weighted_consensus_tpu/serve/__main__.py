@@ -526,7 +526,7 @@ class _ArchivingClient:
                 )
 
 
-def _warmup_embedder(embedder, specs: list) -> None:
+def _warmup_embedder(embedder, specs: list, r_buckets: list = ()) -> None:
     """Pre-compile the consensus path for the given ``NxS`` shapes at
     startup (WARMUP env, serve/config.py) so the first real request
     doesn't pay a multi-second jit compile.  Each spec warms the
@@ -534,7 +534,13 @@ def _warmup_embedder(embedder, specs: list) -> None:
     bucket); invalid specs fail startup loudly (a silently skipped
     warmup defeats its purpose).  S snaps to the serving seq bucket the
     tokenizer would pick, so the compiled shape is the one traffic
-    actually hits."""
+    actually hits.
+
+    ``r_buckets`` (WARMUP_R) additionally warms the batcher's grouped
+    dispatch (``consensus_confidence_tokens_many``) at each concurrency
+    bucket per shape — a distinct XLA specialization per power-of-two R,
+    which the single-request warm does NOT cover (ADVICE r4): without it
+    the first concurrent burst at a warmed NxS still pays the compile."""
     import logging
     import time as _time
 
@@ -560,6 +566,20 @@ def _warmup_embedder(embedder, specs: list) -> None:
             "warmup %dx%d compiled in %.1fs",
             n, s, _time.perf_counter() - t0,
         )
+        for r in r_buckets:
+            if r < 2:
+                continue  # R=1 groups dispatch the single-request path
+            ids_r = np.zeros((r, n, s), dtype=np.int32)
+            mask_r = np.zeros((r, n, s), dtype=np.int32)
+            mask_r[:, :, 0] = 1
+            t0 = _time.perf_counter()
+            np.asarray(
+                embedder.consensus_confidence_tokens_many(ids_r, mask_r)
+            )
+            log.info(
+                "warmup grouped R=%d %dx%d compiled in %.1fs",
+                r, n, s, _time.perf_counter() - t0,
+            )
 
 
 def build_service(
@@ -612,7 +632,7 @@ def build_service(
     # allowed (still logged); production startup refuses them
     embedder = build_embedder(config, allow_synthetic=fake_upstream)
     if embedder is not None and config.warmup:
-        _warmup_embedder(embedder, config.warmup)
+        _warmup_embedder(embedder, config.warmup, config.warmup_r)
     reranker = build_reranker(config, allow_synthetic=fake_upstream)
     batcher = None
     metrics = None

@@ -325,10 +325,10 @@ class DeviceBatcher:
             return
         start = 0
         remaining = len(group)
+        from ..utils import next_pow2
+
         while remaining:
-            bucket = 1
-            while bucket < remaining:
-                bucket *= 2
+            bucket = next_pow2(remaining)
             if (bucket - remaining) * 4 <= bucket:
                 # <=25% padding: one dispatch beats extra round-trips
                 yield group[start:]

@@ -84,6 +84,14 @@ TPU additions:
   otherwise pays a multi-second jit compile (each (N, seq-bucket) is
   its own XLA specialization); pair with ``COMPILE_CACHE_DIR`` to make
   later restarts near-instant.  Invalid specs fail startup loudly.
+* ``WARMUP_R`` — concurrency buckets to ALSO pre-compile for each
+  ``WARMUP`` shape through the batcher's grouped path, e.g. ``2,4``:
+  the grouped dispatch (``consensus_confidence_tokens_many``) is a
+  DISTINCT XLA specialization per power-of-two R bucket, so a warmed
+  ``64x112`` alone still pays a multi-second compile on the first
+  *concurrent* burst at that shape.  Values snap to the next power of
+  two (the runtime bucketing) and dedup.  Default empty: only the
+  single-request (R=1) path is warmed.
 * ``BATCH_MAX_ROWS`` — encoder rows per fused dispatch; a synchronized
   burst of requests chunks into this many rows per dispatch so the
   pipeline has pieces to overlap.  Default 512.
@@ -128,6 +136,35 @@ def _parse_warmup(raw) -> list:
             ) from None
         out.append((n, s))
     return out
+
+
+def _parse_warmup_r(raw) -> list:
+    """"2,4" -> [2, 4], snapped to the runtime's power-of-two R buckets
+    and deduped ("3" warms the same specialization as "4").  Raises on
+    malformed or non-positive values, same loud-failure contract as
+    ``_parse_warmup``."""
+    if not raw:
+        return []
+    buckets = []
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            r = int(part)
+            if r < 1:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"WARMUP_R value {part!r}: expected a positive integer "
+                "concurrency bucket (e.g. 2)"
+            ) from None
+        from ..utils import next_pow2
+
+        bucket = next_pow2(r)
+        if bucket not in buckets:
+            buckets.append(bucket)
+    return buckets
 
 
 def _non_negative_int(env: dict, name: str, default: int) -> int:
@@ -215,6 +252,10 @@ class Config:
     # [(n_candidates, seq), ...] consensus shapes to pre-compile at
     # startup (WARMUP env, e.g. "64x112,64x128"); [] = lazy compiles
     warmup: list = field(default_factory=list)
+    # power-of-two concurrency buckets to pre-compile the grouped
+    # (consensus_confidence_tokens_many) path for, per WARMUP shape
+    # (WARMUP_R env, e.g. "2,4"); [] = single-request path only
+    warmup_r: list = field(default_factory=list)
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -232,7 +273,7 @@ class Config:
                 apis = [{"api_base": base, "api_key": key}]
             else:
                 apis = []
-        return cls(
+        config = cls(
             backoff_initial_interval_millis=get_f(
                 "BACKOFF_INITIAL_INTERVAL_MILLIS", 100
             ),
@@ -291,7 +332,18 @@ class Config:
             batch_pipeline=max(1, int(env.get("BATCH_PIPELINE", 2))),
             batch_max_rows=max(1, int(env.get("BATCH_MAX_ROWS", 512))),
             warmup=_parse_warmup(env.get("WARMUP")),
+            warmup_r=_parse_warmup_r(env.get("WARMUP_R")),
         )
+        if config.warmup_r and not config.warmup:
+            # same loud-failure contract as _parse_warmup: WARMUP_R names
+            # concurrency buckets *per WARMUP shape* — without shapes it
+            # would silently warm nothing
+            raise ValueError(
+                "WARMUP_R is set but WARMUP is empty: the grouped-path "
+                "warmup needs NxS shapes to compile (set WARMUP, e.g. "
+                "WARMUP=64x112 WARMUP_R=2)"
+            )
+        return config
 
     def backoff_policy(self):
         from ..clients.chat import BackoffPolicy

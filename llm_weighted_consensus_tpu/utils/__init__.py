@@ -9,6 +9,20 @@ import uuid
 from . import jsonutil  # noqa: F401
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1) — THE runtime R-bucketing rule.
+
+    One definition shared by the grouped dispatch
+    (models/embedder.py::consensus_confidence_tokens_many), the batcher's
+    chunker (serve/batcher.py::_pow2_chunks) and the WARMUP_R snapping
+    (serve/config.py): the warmup's value depends on pre-compiling exactly
+    the buckets traffic hits, so the snap must never drift."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
 def env_truthy(value) -> bool:
     """The framework's one definition of an env-flag truthy value."""
     return str(value).lower() in ("1", "true", "yes", "on")

@@ -76,9 +76,9 @@ def dumps(obj, *, pretty: bool = False) -> str:
     repr, same string escaping with ensure_ascii=False, same non-finite
     rejection) and ~10x faster on the string/float-heavy serving
     responses (embeddings, SSE frames).  Anything stdlib cannot encode
-    (Decimal) falls back to the exact writer below.  One divergence to
-    avoid: non-str dict keys other than int/float (e.g. bool) — wire
-    types never produce them and neither path is specified for them."""
+    (Decimal) falls back to the exact writer below, which emits dict keys
+    (str/int/float/bool/None) byte-identically to stdlib's coercion and
+    rejects other key types with the same TypeError stdlib raises."""
     if not pretty:
         try:
             # stdlib raises TypeError on any type it doesn't know
@@ -89,15 +89,20 @@ def dumps(obj, *, pretty: bool = False) -> str:
                 ensure_ascii=False,
                 allow_nan=False,
             )
-        except (TypeError, ValueError):
-            # Decimal somewhere (exact writer required), or a non-finite
-            # float (re-raise with this module's contract below)
-            pass
+        except TypeError:
+            pass  # Decimal somewhere: exact writer required
+        except ValueError as exc:
+            # stdlib's ValueError covers two distinct cases: non-finite
+            # floats (fall through so the writer raises this module's
+            # contract error) and circular references (re-raise — the
+            # recursive writer must never be handed a cycle).
+            if "circular" in str(exc).lower():
+                raise
     out: list[str] = []
     if pretty:
-        _write_pretty(obj, out, 0)
+        _write_pretty(obj, out, 0, set())
     else:
-        _write_compact(obj, out)
+        _write_compact(obj, out, set())
     return "".join(out)
 
 
@@ -121,34 +126,68 @@ def _write_scalar(obj, out: list[str]) -> bool:
     return True
 
 
-def _write_compact(obj, out: list[str]) -> None:
+def _key_str(k) -> str:
+    """Object key coerced exactly as the stdlib fast path coerces it
+    (bool -> "true"/"false", None -> "null", float -> shortest repr), so a
+    Decimal elsewhere in the payload can never flip the key encoding.
+    Other key types get the same TypeError stdlib raises."""
+    if isinstance(k, str):
+        return k
+    if k is True:
+        return "true"
+    if k is False:
+        return "false"
+    if k is None:
+        return "null"
+    if isinstance(k, float):
+        return _format_float(k)
+    if isinstance(k, int):
+        # UNBOUND repr, same reason as _format_float: int subclasses with
+        # a custom __str__/__repr__ must encode like the stdlib fast path
+        return int.__repr__(k)
+    raise TypeError(
+        f"keys must be str, int, float, bool or None, not {type(k).__name__}"
+    )
+
+
+def _enter(obj, seen: set) -> None:
+    if id(obj) in seen:
+        raise ValueError("Circular reference detected")
+    seen.add(id(obj))
+
+
+def _write_compact(obj, out: list[str], seen: set) -> None:
     if _write_scalar(obj, out):
         return
     if isinstance(obj, dict):
+        _enter(obj, seen)
         out.append("{")
         first = True
         for k, v in obj.items():
             if not first:
                 out.append(",")
             first = False
-            out.append(_escape_string(str(k)))
+            out.append(_escape_string(_key_str(k)))
             out.append(":")
-            _write_compact(v, out)
+            _write_compact(v, out, seen)
         out.append("}")
+        seen.discard(id(obj))
     elif isinstance(obj, (list, tuple)):
+        _enter(obj, seen)
         out.append("[")
         first = True
         for v in obj:
             if not first:
                 out.append(",")
             first = False
-            _write_compact(v, out)
+            _write_compact(v, out, seen)
         out.append("]")
+        seen.discard(id(obj))
     else:
         raise TypeError(f"cannot serialize {type(obj)!r} to JSON")
 
 
-def _write_pretty(obj, out: list[str], indent: int) -> None:
+def _write_pretty(obj, out: list[str], indent: int, seen: set) -> None:
     if _write_scalar(obj, out):
         return
     pad = "  " * (indent + 1)
@@ -157,6 +196,7 @@ def _write_pretty(obj, out: list[str], indent: int) -> None:
         if not obj:
             out.append("{}")
             return
+        _enter(obj, seen)
         out.append("{\n")
         first = True
         for k, v in obj.items():
@@ -164,16 +204,18 @@ def _write_pretty(obj, out: list[str], indent: int) -> None:
                 out.append(",\n")
             first = False
             out.append(pad)
-            out.append(_escape_string(str(k)))
+            out.append(_escape_string(_key_str(k)))
             out.append(": ")
-            _write_pretty(v, out, indent + 1)
+            _write_pretty(v, out, indent + 1, seen)
         out.append("\n")
         out.append(end_pad)
         out.append("}")
+        seen.discard(id(obj))
     elif isinstance(obj, (list, tuple)):
         if not obj:
             out.append("[]")
             return
+        _enter(obj, seen)
         out.append("[\n")
         first = True
         for v in obj:
@@ -181,10 +223,11 @@ def _write_pretty(obj, out: list[str], indent: int) -> None:
                 out.append(",\n")
             first = False
             out.append(pad)
-            _write_pretty(v, out, indent + 1)
+            _write_pretty(v, out, indent + 1, seen)
         out.append("\n")
         out.append(end_pad)
         out.append("]")
+        seen.discard(id(obj))
     else:
         raise TypeError(f"cannot serialize {type(obj)!r} to JSON")
 

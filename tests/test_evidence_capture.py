@@ -1,0 +1,195 @@
+"""Wedge-proof driver evidence capture (VERDICT r4 next-1).
+
+Round 4's lesson: a wedged TPU tunnel HANGS backend init (nothing to
+catch), the axon sitecustomize preload trumps ``JAX_PLATFORMS=cpu``, and
+one wedged tunnel erased the whole round's perf evidence
+(BENCH_r04 rc=1 / MULTICHIP_r04 rc=124).  These tests simulate the wedge
+and assert the two driver entry points stay machine-readable:
+
+* ``bench.py`` must emit exactly ONE parseable JSON record — degraded,
+  with ``error``/``backend`` fields — when the probe hangs, fails, or the
+  bench itself dies.  Never a bare traceback.
+* ``__graft_entry__.dryrun_multichip`` must never initialize the parent
+  process's JAX backend: it either reuses an already-initialized backend
+  or routes to a clean-env CPU subprocess whose env has the axon preload
+  scrubbed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def run_bench(extra_args, probe_code, timeout=120):
+    """Run bench.py in a scrubbed-CPU subprocess with the probe body
+    overridden (the wedge simulation knob)."""
+    from llm_weighted_consensus_tpu.parallel.dist import force_cpu_env
+
+    env = force_cpu_env(dict(os.environ), 2)
+    env["LWC_BENCH_PROBE_CODE"] = probe_code
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, BENCH, *extra_args],
+        capture_output=True,
+        text=True,
+        errors="replace",
+        env=env,
+        cwd=REPO,
+        timeout=timeout,
+    )
+
+
+def parse_single_json_line(stdout: str) -> dict:
+    lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one output line, got: {lines!r}"
+    return json.loads(lines[0])
+
+
+def test_bench_emits_degraded_record_when_probe_hangs():
+    """Simulated wedge: the probe subprocess sleeps past the bound.  The
+    bench must come back quickly with one structured JSON record, not hang
+    until the driver's rc=124."""
+    proc = run_bench(
+        ["--probe-timeout", "2"], "import time; time.sleep(60)"
+    )
+    assert proc.returncode == 2, proc.stderr[-2000:]
+    rec = parse_single_json_line(proc.stdout)
+    assert rec["value"] is None
+    assert rec["unit"] == "answers/sec"
+    assert rec["error"].startswith("tpu-unavailable")
+    assert "wedged" in rec["error"]
+    assert rec["backend"] is None
+    assert rec["model"] == "bge-large-en"
+
+
+def test_bench_emits_degraded_record_when_probe_dies():
+    proc = run_bench(["--probe-timeout", "30"], "raise SystemExit(3)")
+    assert proc.returncode == 2, proc.stderr[-2000:]
+    rec = parse_single_json_line(proc.stdout)
+    assert rec["value"] is None
+    assert "rc=3" in rec["error"]
+
+
+def test_bench_emits_structured_record_when_bench_itself_dies():
+    """Probe OK, but the bench body raises (unknown model): still one JSON
+    line, now flagged bench-failed, with the exception text inside."""
+    proc = run_bench(
+        ["--model", "no-such-model", "--probe-timeout", "30"],
+        "print('BACKEND=cpu NDEV=2')",
+    )
+    assert proc.returncode == 1, proc.stderr[-2000:]
+    rec = parse_single_json_line(proc.stdout)
+    assert rec["value"] is None
+    assert rec["error"].startswith("bench-failed")
+    assert "no-such-model" in rec["error"]
+    assert rec["backend"] == "cpu"
+
+
+def test_dryrun_multichip_never_initializes_parent_backend():
+    """Poisoned-parent simulation: backend init in the parent raises
+    SystemExit (escapes ``except Exception`` guards — a hang cannot be
+    caught either, which is the point).  dryrun_multichip must route to
+    the clean-env subprocess, whose env has the axon preload scrubbed and
+    the virtual CPU mesh forced.  subprocess.run is intercepted so the
+    test verifies *routing* in ~1s; the real 8-device CPU dryrun is
+    exercised end-to-end by tests/test_parallel.py and the driver."""
+    code = textwrap.dedent(
+        """
+        import subprocess, sys
+        import __graft_entry__ as g
+        import jax
+        from jax._src import xla_bridge
+
+        def boom(*a, **k):
+            sys.exit("POISON: parent backend init attempted")
+
+        xla_bridge.backends = boom
+        xla_bridge.get_backend = boom
+        jax.devices = boom
+
+        captured = {}
+
+        def fake_run(cmd, **kw):
+            captured["env"] = kw["env"]
+            class P:
+                returncode = 0
+                stdout = "dryrun-subprocess-ok\\n"
+                stderr = ""
+            return P()
+
+        subprocess.run = fake_run
+        g.dryrun_multichip(8)
+        env = captured["env"]
+        assert not env.get("PALLAS_AXON_POOL_IPS"), env
+        assert env.get("JAX_PLATFORMS") == "cpu", env
+        assert "--xla_force_host_platform_device_count=8" in env.get(
+            "XLA_FLAGS", ""
+        ), env
+        print("routing-ok")
+        """
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        errors="replace",
+        env=env,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "routing-ok" in proc.stdout
+
+
+def test_parent_device_count_peeks_without_initializing():
+    """_parent_device_count on a process whose backend is uninitialized
+    returns None AND leaves the initialized-backend cache empty."""
+    code = textwrap.dedent(
+        """
+        import __graft_entry__ as g
+        import jax
+        from jax._src import xla_bridge
+
+        assert g._parent_device_count() is None
+        assert not getattr(xla_bridge, "_backends", None), (
+            "peek initialized the backend"
+        )
+        print("peek-ok")
+        """
+    )
+    from llm_weighted_consensus_tpu.parallel.dist import force_cpu_env
+
+    env = force_cpu_env(dict(os.environ), 2)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        errors="replace",
+        env=env,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "peek-ok" in proc.stdout
+
+
+def test_parent_device_count_reuses_initialized_backend():
+    """In this pytest process the virtual 8-device CPU backend IS
+    initialized (conftest) — the peek must see it so the in-process fast
+    path still exists."""
+    jax = pytest.importorskip("jax")
+    jax.devices()  # ensure initialized
+    import __graft_entry__ as g
+
+    n = g._parent_device_count()
+    assert n is not None and n >= 8

@@ -359,6 +359,45 @@ def test_warmup_compiles_configured_shapes():
     assert calls == [((4, 16), (4, 16)), ((6, 32), (6, 32))]
 
 
+def test_config_warmup_r_parsing():
+    c = Config.from_env({"WARMUP": "64x112", "WARMUP_R": "2, 3, 4"})
+    assert c.warmup_r == [2, 4]  # 3 snaps to the pow2 bucket 4, dedups
+    assert Config.from_env({}).warmup_r == []
+    assert Config.from_env({"WARMUP_R": ""}).warmup_r == []
+    import pytest as _pytest
+
+    for bad in ("0", "-2", "two", "2x3"):
+        with _pytest.raises(ValueError):
+            Config.from_env({"WARMUP": "64x112", "WARMUP_R": bad})
+
+
+def test_warmup_r_compiles_grouped_path():
+    """WARMUP_R warms the batcher's grouped dispatch per shape — a
+    distinct specialization per R bucket the single-request warm does
+    not cover (ADVICE r4) — and the warmed grouped output still sums to
+    one per request slot."""
+    pytest.importorskip("jax")
+    import numpy as np
+
+    from llm_weighted_consensus_tpu.serve.__main__ import _warmup_embedder
+
+    embedder = _tiny_embedder()
+    many_calls = []
+    real_many = embedder.consensus_confidence_tokens_many
+    embedder.consensus_confidence_tokens_many = lambda ids, mask, *a: (
+        many_calls.append(ids.shape) or real_many(ids, mask, *a)
+    )
+    _warmup_embedder(embedder, [(4, 16)], r_buckets=[1, 2])
+    # R=1 rides the single-request path (already warmed); only R=2 hits
+    # the grouped dispatch
+    assert many_calls == [(2, 4, 16)]
+    conf = np.asarray(real_many(np.zeros((2, 4, 16), np.int32),
+                                np.eye(1, 16, dtype=np.int32)[None]
+                                .repeat(4, 0)[None].repeat(2, 0)
+                                .reshape(2, 4, 16)))
+    np.testing.assert_allclose(conf.sum(axis=1), 1.0, atol=1e-4)
+
+
 def test_config_single_api_base_fallback():
     c = Config.from_env({"OPENAI_API_BASE": "https://x", "OPENAI_API_KEY": "s"})
     assert [a.api_key for a in c.api_bases()] == ["s"]
@@ -1697,3 +1736,98 @@ def test_oversized_body_keeps_413():
         assert resp.status == 413
 
     go(with_client(app, run))
+
+
+def test_unexpected_500_never_leaks_exception_text():
+    """Unexpected (non-StatusError) exceptions map to the uniform
+    ``{"code": 500, "message": "internal error"}`` envelope — the
+    exception text stays in the server log and NEVER reaches the response
+    body, matching the reference's envelope (src/error.rs:8-13)."""
+    from llm_weighted_consensus_tpu.serve.gateway import build_app
+
+    secret = "sk-internal-XYZ /root/secret/path.py line 42"
+
+    class Exploding:
+        async def create_unary(self, ctx, params):
+            raise RuntimeError(secret)
+
+        async def create_streaming(self, ctx, params):
+            raise RuntimeError(secret)
+
+    stub = Exploding()
+    app = build_app(stub, stub, stub)
+
+    async def run(client):
+        for stream in (False, True):
+            resp = await client.post(
+                "/chat/completions",
+                json={
+                    "model": "m",
+                    "stream": stream,
+                    "messages": [{"role": "user", "content": "q"}],
+                },
+            )
+            assert resp.status == 500
+            text = await resp.text()
+            assert secret not in text
+            assert json.loads(text) == {
+                "code": 500,
+                "message": "internal error",
+            }
+
+    go(with_client(app, run))
+
+
+def test_unexpected_midstream_error_frame_never_leaks():
+    """The stream is already 200/SSE when an unexpected exception
+    surfaces as a stream item: the error FRAME gets the uniform envelope
+    too — the leak fix covers mid-stream, not just pre-stream
+    (errors.to_response_error fallback)."""
+    from llm_weighted_consensus_tpu.serve.gateway import build_app
+    from llm_weighted_consensus_tpu.types.chat_response import (
+        ChatCompletionChunk,
+    )
+
+    secret = "ClientConnectorError(host='internal-api.corp', sk-XYZ)"
+
+    class MidstreamExploding:
+        async def create_unary(self, ctx, params):
+            raise AssertionError("unary not used here")
+
+        async def create_streaming(self, ctx, params):
+            async def gen():
+                yield ChatCompletionChunk.from_json_obj(
+                    chunk_obj("partial")
+                )
+                yield RuntimeError(secret)
+
+            return gen()
+
+    stub = MidstreamExploding()
+    app = build_app(stub, stub, stub)
+
+    async def run(client):
+        resp = await client.post(
+            "/chat/completions",
+            json={
+                "model": "m",
+                "stream": True,
+                "messages": [{"role": "user", "content": "q"}],
+            },
+        )
+        assert resp.status == 200  # stream already established
+        text = await resp.text()
+        assert secret not in text
+        events = sse_events(text)
+        assert events[-1] == "[DONE]"
+        error_frame = json.loads(events[-2])
+        assert error_frame == {"code": 500, "message": "internal error"}
+
+    go(with_client(app, run))
+
+
+def test_warmup_r_without_warmup_fails_loudly():
+    """WARMUP_R names buckets *per WARMUP shape*; with no shapes it would
+    silently warm nothing — startup must refuse instead."""
+    with pytest.raises(ValueError, match="WARMUP_R"):
+        Config.from_env({"WARMUP_R": "2"})

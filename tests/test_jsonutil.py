@@ -51,7 +51,7 @@ def test_fast_path_identical_to_exact_writer_fuzz():
         assert ours == std, (obj, ours, std)
         # and the slow writer agrees too (the identity contract)
         slow: list = []
-        jsonutil._write_compact(obj, slow)
+        jsonutil._write_compact(obj, slow, set())
         assert "".join(slow) == std, obj
 
 
@@ -106,3 +106,56 @@ def test_roundtrip_loads_preserves_decimal():
     obj = jsonutil.loads('{"x": 1.50, "n": 3}')
     assert obj["x"] == Decimal("1.50") and isinstance(obj["x"], Decimal)
     assert math.isclose(float(obj["x"]), 1.5)
+
+
+def test_circular_reference_raises_cleanly_both_paths():
+    """A cycle raises ValueError from BOTH paths — the stdlib fast path's
+    circular ValueError must not be swallowed into the recursive writer
+    (where it used to die as RecursionError), and the writer detects
+    cycles itself when a Decimal forces the fallback (ADVICE r4)."""
+    cyc = {"a": 1}
+    cyc["self"] = cyc
+    for obj in (cyc, {"d": Decimal("1.0"), "c": cyc}):
+        try:
+            jsonutil.dumps(obj)
+        except ValueError as exc:
+            assert "circular" in str(exc).lower()
+        else:
+            raise AssertionError("cycle did not raise")
+    lst = [Decimal("1.0")]
+    lst.append(lst)
+    try:
+        jsonutil.dumps(lst, pretty=True)
+    except ValueError as exc:
+        assert "circular" in str(exc).lower()
+    else:
+        raise AssertionError("list cycle did not raise")
+    # shared (diamond) references are NOT cycles and must serialize fine
+    shared = {"x": Decimal("2.5")}
+    assert (
+        jsonutil.dumps({"a": shared, "b": shared})
+        == '{"a":{"x":2.5},"b":{"x":2.5}}'
+    )
+
+
+def test_non_str_keys_byte_identical_across_paths():
+    """bool/None/int/float dict keys encode exactly like the stdlib fast
+    path even when a Decimal elsewhere forces the exact writer
+    (ADVICE r4: {True: 1} used to flip "true" -> "True")."""
+    keys = {True: 1, False: 0, None: 2, 3: 3, 1.5: 4}
+    fast = jsonutil.dumps(keys)
+    assert fast == json.dumps(
+        keys, separators=(",", ":"), ensure_ascii=False
+    )
+    slow = jsonutil.dumps({**keys, "d": Decimal("1.0")})
+    assert slow == fast[:-1] + ',"d":1.0}'
+
+
+def test_invalid_key_type_raises_typeerror_both_paths():
+    for obj in ({(1, 2): "t"}, {(1, 2): "t", "d": Decimal("1.0")}):
+        try:
+            jsonutil.dumps(obj)
+        except TypeError:
+            pass
+        else:
+            raise AssertionError("tuple key did not raise")

@@ -395,3 +395,79 @@ def test_model_family_presets_and_pooling():
     np.testing.assert_allclose(
         np.linalg.norm(out, axis=1), 1.0, atol=1e-5
     )
+
+
+def test_bf16_serving_numerics_track_f32():
+    """The TPU serving dtype (bf16 logit/score storage, models/bert.py)
+    asserted against the f32 path ON CPU — an executable bound, not a
+    comment (ADVICE r4): end-to-end embedding cosine stays high and the
+    consensus vote keeps its argmax and a close distribution."""
+    kwargs = dict(config=TINY, max_tokens=32, seed=3)
+    f32 = TpuEmbedder("test-tiny", **kwargs)
+    bf16 = TpuEmbedder("test-tiny", dtype=jnp.bfloat16, **kwargs)
+    texts = [
+        "the answer is four",
+        "the answer is four",
+        "the answer is four!",
+        "bananas and poetry 999",
+    ]
+    ef = np.asarray(f32.embed_texts(texts), np.float32)
+    eb = np.asarray(bf16.embed_texts(texts), np.float32)
+    cos = (ef * eb).sum(axis=1)  # embeddings are l2-normalized
+    assert cos.min() > 0.995, cos
+    cf = np.asarray(f32.consensus_confidence(texts))
+    cb = np.asarray(bf16.consensus_confidence(texts))
+    assert cf.argmax() == cb.argmax()
+    assert abs(float(cb.sum()) - 1.0) < 1e-3
+    assert np.abs(cf - cb).max() < 0.05, (cf, cb)
+
+
+def test_bf16_golden_checkpoint_vote_agreement():
+    """bf16 through the committed HF-snapshot golden checkpoint: real
+    weights, real tokenizer — the serving dtype must preserve the vote
+    (same contract test_quant.py pins for int8)."""
+    import json
+    import os
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures", "bge_micro")
+    if not os.path.isdir(fixture):
+        pytest.skip("golden checkpoint fixture missing")
+    from llm_weighted_consensus_tpu.models.loading import (
+        find_vocab,
+        load_params,
+    )
+    from llm_weighted_consensus_tpu.models.tokenizer import load_tokenizer
+
+    with open(os.path.join(fixture, "config.json")) as f:
+        cfg = json.load(f)
+    config = configs.BertConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=cfg["num_attention_heads"],
+        intermediate_size=cfg["intermediate_size"],
+        max_position_embeddings=cfg["max_position_embeddings"],
+        type_vocab_size=cfg["type_vocab_size"],
+        layer_norm_eps=cfg["layer_norm_eps"],
+    )
+    params = load_params(fixture, config)
+    tok = load_tokenizer(find_vocab(fixture))
+    kwargs = dict(config=config, tokenizer=tok, max_tokens=64)
+    f32 = TpuEmbedder("bge-micro", params=params, **kwargs)
+    bf16 = TpuEmbedder(
+        "bge-micro", params=params, dtype=jnp.bfloat16, **kwargs
+    )
+    texts = [
+        "paris is the capital of france",
+        "the capital of france is paris",
+        "paris, france's capital city",
+        "bananas are curved and yellow",
+    ]
+    ef = np.asarray(f32.embed_texts(texts), np.float32)
+    eb = np.asarray(bf16.embed_texts(texts), np.float32)
+    cos = (ef * eb).sum(axis=1)
+    assert cos.min() > 0.99, cos
+    cf = np.asarray(f32.consensus_confidence(texts))
+    cb = np.asarray(bf16.consensus_confidence(texts))
+    assert cf.argmax() == cb.argmax()
+    assert np.abs(cf - cb).max() < 0.05, (cf, cb)

@@ -311,6 +311,11 @@ def main() -> int:
     try:
         return run_bench(args, probe["backend"])
     except Exception as exc:
+        # full traceback to stderr (the only diagnosable evidence after a
+        # one-shot driver run); stdout keeps the one-JSON-line contract
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
         emit_degraded(args, {"backend": probe["backend"], "error": repr(exc)},
                       "bench-failed")
         return 1

@@ -551,8 +551,30 @@ def main() -> int:
         action="store_true",
         help="skip the second reproducibility run (no runs/max_dev_pct)",
     )
+    parser.add_argument(
+        "--probe-timeout",
+        type=float,
+        default=240.0,
+        help="hard bound (s) on the throwaway backend-init probe "
+        "(bench.py wedge-proofing)",
+    )
     args = parser.parse_args()
     q = args.quick
+
+    # bound backend init in a throwaway subprocess (same wedge-proofing as
+    # bench.py): a wedged TPU tunnel HANGS init, and a hung bench_all
+    # leaves no machine-readable round state
+    from bench import probe_backend
+
+    probe = probe_backend(args.probe_timeout)
+    if not probe["ok"]:
+        print(json.dumps({
+            "metric": "bench_all configs 1-6",
+            "value": None,
+            "error": f"tpu-unavailable: {probe['error']}",
+            "backend": probe.get("backend"),
+        }), flush=True)
+        return 2
     shared = _shared_embedders(q)
 
     n_runs = 1 if args.single_run else (2 if q else 3)

@@ -332,15 +332,9 @@ async def main_async(args) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    try:
-        import jax
-
-        default_model = (
-            "bge-large-en" if jax.default_backend() == "tpu" else "test-tiny"
-        )
-    except Exception:
-        default_model = "test-tiny"
-    parser.add_argument("--model", default=default_model)
+    # default resolved AFTER parse_args via the bounded probe — --help and
+    # explicit --model runs must not pay a backend-init subprocess
+    parser.add_argument("--model", default=None)
     parser.add_argument(
         "--quantize",
         choices=("none", "int8"),
@@ -354,10 +348,32 @@ def main() -> None:
     parser.add_argument(
         "--quick", action="store_true", help="small counts for CI/CPU"
     )
+    parser.add_argument(
+        "--probe-timeout",
+        type=float,
+        default=240.0,
+        help="hard bound (s) on the throwaway backend-init probe "
+        "(bench.py wedge-proofing); on expiry a degraded JSON record is "
+        "emitted instead of hanging",
+    )
     args = parser.parse_args()
     if args.quick:
         args.requests = min(args.requests, 20)
         args.n = min(args.n, 8)
+    # bound backend init in a throwaway subprocess and HONOR the result:
+    # a wedged tunnel must produce one machine-readable line, never an
+    # in-parent hang (the r4 failure mode)
+    from bench import emit_degraded, probe_backend
+
+    probe = probe_backend(args.probe_timeout)
+    if not probe["ok"]:
+        args.seq = None  # emit_degraded's envelope fields
+        if args.model is None:
+            args.model = "bge-large-en"
+        emit_degraded(args, probe, "tpu-unavailable")
+        raise SystemExit(2)
+    if args.model is None:
+        args.model = "bge-large-en" if probe["backend"] == "tpu" else "test-tiny"
     asyncio.run(main_async(args))
 
 

@@ -220,19 +220,19 @@ def run_load_test() -> None:
 
 
 def main() -> None:
-    try:
-        import jax
+    # peek at an ALREADY-initialized backend only (__graft_entry__ pattern):
+    # initializing here would hang on a wedged TPU tunnel, and this bench
+    # only ever needs the virtual CPU mesh
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from __graft_entry__ import _parent_device_count
 
-        have = len(jax.devices())
-    except Exception:
-        have = 0
+    have = _parent_device_count() or 0
     if have >= 8:
         run_inprocess()
         run_load_test()
         return
     # re-exec on a virtual 8-device CPU mesh (same pattern as
-    # __graft_entry__.dryrun_multichip)
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # __graft_entry__.dryrun_multichip); script dir already on sys.path
     from __graft_entry__ import _virtual_cpu_env
 
     env = _virtual_cpu_env(8)

@@ -21,7 +21,7 @@ from ..clients.multichat import MultichatClient
 from ..clients.score import ScoreClient
 from ..weights import WeightFetchers
 from .config import Config, load_dotenv
-from .gateway import build_app
+from .gateway import _parse_error_response, build_app
 
 FAKE_PORT = 5990
 
@@ -64,10 +64,22 @@ def _rescore_handler(store, lock, mesh=None):
             body = jsonutil.loads(await request.text() or "{}")
             if not isinstance(body, dict):
                 return bad_request("body must be a JSON object")
-            overrides = {
-                str(judge): float(w)
-                for judge, w in (body.get("weight_overrides") or {}).items()
-            }
+            oraw = body.get("weight_overrides") or {}
+            if not isinstance(oraw, dict):
+                raise ValueError(
+                    "`weight_overrides` must map judge ids to numbers"
+                )
+            from decimal import Decimal as _Decimal
+
+            overrides = {}
+            for judge, w in oraw.items():
+                if isinstance(w, bool) or not isinstance(
+                    w, (int, float, _Decimal)
+                ):
+                    raise ValueError(
+                        f"`weight_overrides[{judge!r}]` must be a number"
+                    )
+                overrides[str(judge)] = float(w)
             ids = body.get("ids")
             revote = bool(body.get("revote", False))
             apply = bool(body.get("apply", False))
@@ -75,7 +87,7 @@ def _rescore_handler(store, lock, mesh=None):
         except web.HTTPException:
             raise  # e.g. 413 body-too-large must keep its status
         except Exception as e:  # parse phase: malformed input, not a fault
-            return bad_request(str(e))
+            return _parse_error_response(e)
         # validation beyond parsing stays OUTSIDE the blanket except: a
         # store fault must surface as a 500, not masquerade as a 400
         if ids is not None:
@@ -131,22 +143,28 @@ def _learn_handler(store, embedder, tables, lock):
     async def handler(request: web.Request):
         try:
             body = jsonutil.loads(await request.text())
+            if not isinstance(body, dict) or "model" not in body:
+                raise ValueError("missing required field `model`")
             model = ModelBase.from_json_obj(
                 body["model"]
             ).into_model_validate()
-            labels = {
-                str(cid): int(idx)
-                for cid, idx in (body.get("labels") or {}).items()
-            }
+            lraw = body.get("labels") or {}
+            if not isinstance(lraw, dict):
+                raise ValueError(
+                    "`labels` must map completion ids to candidate indexes"
+                )
+            labels = {}
+            for cid, idx in lraw.items():
+                if isinstance(idx, bool) or not isinstance(idx, int):
+                    raise ValueError(
+                        f"`labels[{cid!r}]` must be an integer index"
+                    )
+                labels[str(cid)] = int(idx)
             ids = body.get("ids")
         except web.HTTPException:
             raise  # e.g. 413 body-too-large must keep its status
         except Exception as e:  # parse phase: malformed input, not a fault
-            return web.Response(
-                status=400,
-                text=jsonutil.dumps({"code": 400, "message": str(e)}),
-                content_type="application/json",
-            )
+            return _parse_error_response(e)
         async with lock:
             added = await asyncio.get_running_loop().run_in_executor(
                 None,

@@ -73,6 +73,31 @@ async def _respond_streaming(request: web.Request, stream) -> web.StreamResponse
     return resp
 
 
+def _parse_error_response(e: Exception) -> web.Response:
+    """The parse-phase 400 policy, one definition for every endpoint.
+
+    The EXPECTED malformed-request classes — SchemaError (path-annotated,
+    types/base.py) and the json decoder's JSONDecodeError — are
+    ValueErrors whose text describes the *client's input*: safe and
+    useful to echo (the serde_path_to_error surface).  Anything else is a
+    latent decoder bug, not client input: same masking policy as the 500
+    envelope — detail to the server log only, never into the body."""
+    if isinstance(e, ValueError):
+        message: object = str(e)
+    else:
+        import logging
+
+        logging.getLogger("lwc.serve").error(
+            "unexpected parse-phase error", exc_info=e
+        )
+        message = "malformed request body"
+    return web.Response(
+        status=400,
+        text=jsonutil.dumps({"code": 400, "message": message}),
+        content_type="application/json",
+    )
+
+
 def _make_handler(params_cls, create_streaming, create_unary):
     async def handler(request: web.Request):
         try:
@@ -80,13 +105,10 @@ def _make_handler(params_cls, create_streaming, create_unary):
             params = params_cls.from_json_obj(body)
         except web.HTTPException:
             raise  # e.g. 413 body-too-large must keep its status
-        except Exception as e:  # parse phase is side-effect free: any
-            # failure here is a malformed request, never a server fault
-            return web.Response(
-                status=400,
-                text=jsonutil.dumps({"code": 400, "message": str(e)}),
-                content_type="application/json",
-            )
+        except Exception as e:  # parse phase is side-effect free: never
+            # a server-state fault — 400 with the path-annotated message
+            # (or masked, for non-ValueError: see _parse_error_response)
+            return _parse_error_response(e)
         ctx = request.headers.get("authorization")
         if params.stream:
             try:
@@ -391,9 +413,20 @@ def _consensus_handler(embedder, metrics=None, batcher=None, reranker=None):
             prompt = body.get("prompt")
             if prompt is not None and not isinstance(prompt, str):
                 raise ValueError("`prompt` must be a string")
-            temperature = float(
-                body.get("temperature", 0.05 if scorer == "cosine" else 1.0)
+            traw = body.get(
+                "temperature", 0.05 if scorer == "cosine" else 1.0
             )
+            # explicit type check, not bare float(): a non-numeric value
+            # must raise the ValueError the 400 policy echoes, never a
+            # TypeError the policy masks as a server bug (jsonutil.loads
+            # parses JSON floats as Decimal)
+            from decimal import Decimal as _Decimal
+
+            if isinstance(traw, bool) or not isinstance(
+                traw, (int, float, _Decimal)
+            ):
+                raise ValueError("`temperature` must be a number")
+            temperature = float(traw)
             import math
 
             if not math.isfinite(temperature) or temperature <= 0:
@@ -403,11 +436,7 @@ def _consensus_handler(embedder, metrics=None, batcher=None, reranker=None):
         except web.HTTPException:
             raise  # e.g. 413 body-too-large must keep its status
         except Exception as e:  # parse phase is side-effect free
-            return web.Response(
-                status=400,
-                text=jsonutil.dumps({"code": 400, "message": str(e)}),
-                content_type="application/json",
-            )
+            return _parse_error_response(e)
         loop = asyncio.get_running_loop()
         try:
             if scorer == "rm":
@@ -477,13 +506,8 @@ def _embeddings_handler(embedder, metrics=None, batcher=None):
             )
         except web.HTTPException:
             raise  # e.g. 413 body-too-large must keep its status
-        except Exception as e:  # parse phase is side-effect free: any
-            # failure here is a malformed request, never a server fault
-            return web.Response(
-                status=400,
-                text=jsonutil.dumps({"code": 400, "message": str(e)}),
-                content_type="application/json",
-            )
+        except Exception as e:  # parse phase is side-effect free
+            return _parse_error_response(e)
         if params.model and params.model != embedder.model_name:
             return web.Response(
                 status=400,

@@ -118,7 +118,10 @@ def _write_scalar(obj, out: list[str]) -> bool:
     elif isinstance(obj, Decimal):
         out.append(_format_decimal(obj))
     elif isinstance(obj, int):
-        out.append(str(obj))
+        # UNBOUND repr (same contract as _format_float/_key_str): an int
+        # subclass with a custom __str__ must encode like the stdlib fast
+        # path, not emit its display text as raw JSON
+        out.append(int.__repr__(obj))
     elif isinstance(obj, float):
         out.append(_format_float(obj))
     else:

@@ -1831,3 +1831,24 @@ def test_warmup_r_without_warmup_fails_loudly():
     silently warm nothing — startup must refuse instead."""
     with pytest.raises(ValueError, match="WARMUP_R"):
         Config.from_env({"WARMUP_R": "2"})
+
+
+def test_parse_phase_masks_non_valueerror_exceptions():
+    """Expected malformed-input classes (SchemaError/JSONDecodeError, both
+    ValueErrors) echo their path-annotated text; a latent decoder bug
+    (non-ValueError) is masked like the 500 envelope — detail never
+    reaches the body."""
+    from llm_weighted_consensus_tpu.serve.gateway import (
+        _parse_error_response,
+    )
+    from llm_weighted_consensus_tpu.types.base import SchemaError
+
+    echoed = _parse_error_response(SchemaError("temperature", "expected number"))
+    assert json.loads(echoed.text)["message"] == "temperature: expected number"
+
+    secret = "'NoneType' object has no attribute '/etc/internal'"
+    masked = _parse_error_response(AttributeError(secret))
+    assert masked.status == 400
+    body = json.loads(masked.text)
+    assert body == {"code": 400, "message": "malformed request body"}
+    assert secret not in masked.text

@@ -246,6 +246,9 @@ def base_record(args) -> dict:
     """The record envelope shared by the success and degraded prints —
     one definition so a metric-string tweak can never desynchronize the
     two outcomes a round-state parser must match."""
+    # getattr with defaults: sibling benches (bench_http) reuse this
+    # envelope with their own arg namespaces — a missing field must never
+    # turn the degraded path into an AttributeError with no JSON line
     return {
         "metric": (
             f"consensus answers/sec + p50 latency at N={args.n} "
@@ -255,9 +258,9 @@ def base_record(args) -> dict:
         "unit": "answers/sec",
         "vs_baseline": None,
         "n_candidates": args.n,
-        "seq": args.seq,
+        "seq": getattr(args, "seq", None),
         "model": args.model,
-        "quantize": args.quantize,
+        "quantize": getattr(args, "quantize", "none"),
     }
 
 
@@ -322,10 +325,22 @@ def main() -> int:
 
 
 def run_bench(args, backend: str) -> int:
+    import os
+
     import jax
     import jax.numpy as jnp
 
     from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+    if os.environ.get("COMPILE_CACHE_DIR"):
+        # same persistent-XLA-cache knob serving honors: repeat bench runs
+        # (and the driver's round-end capture) skip the tens-of-seconds
+        # bge-large specialization compiles
+        from llm_weighted_consensus_tpu.serve.config import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache(os.environ["COMPILE_CACHE_DIR"])
 
     dtype = jnp.bfloat16 if backend == "tpu" else jnp.float32
 

@@ -17,6 +17,7 @@ table in ops/attention.py.
 from __future__ import annotations
 
 import argparse
+import sys
 import functools
 import json
 import time
@@ -77,7 +78,17 @@ def main():
     p.add_argument("--hd", type=int, default=64)
     p.add_argument("--seqs", default="128,256,512")
     p.add_argument("--ks", default="8,16,32")
+    p.add_argument("--probe-timeout", type=float, default=240.0)
     args = p.parse_args()
+    # wedge-proofing (bench.py pattern): bound backend init in a throwaway
+    # subprocess AFTER argparse (--help must stay instant); a wedged
+    # tunnel must fail fast with a parseable record, not hang
+    from bench import probe_backend
+
+    _probe = probe_backend(args.probe_timeout)
+    if not _probe["ok"]:
+        print(json.dumps({"error": f"tpu-unavailable: {_probe['error']}"}))
+        return 2
 
     from llm_weighted_consensus_tpu.ops.attention import fused_attention_tiled
 
@@ -123,4 +134,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -10,6 +10,7 @@ identity), layernorm (real vs identity).  Grounds VERDICT r3 item 1.
 from __future__ import annotations
 
 import argparse
+import sys
 import functools
 import json
 import time
@@ -49,7 +50,17 @@ def main():
     p.add_argument("--model", default="bge-large-en")
     p.add_argument("--b", type=int, default=64)
     p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--probe-timeout", type=float, default=240.0)
     args = p.parse_args()
+    # wedge-proofing (bench.py pattern): bound backend init in a throwaway
+    # subprocess AFTER argparse (--help must stay instant); a wedged
+    # tunnel must fail fast with a parseable record, not hang
+    from bench import probe_backend
+
+    _probe = probe_backend(args.probe_timeout)
+    if not _probe["ok"]:
+        print(json.dumps({"error": f"tpu-unavailable: {_probe['error']}"}))
+        return 2
 
     import dataclasses
 
@@ -97,4 +108,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -367,7 +367,6 @@ def main() -> None:
 
     probe = probe_backend(args.probe_timeout)
     if not probe["ok"]:
-        args.seq = None  # emit_degraded's envelope fields
         if args.model is None:
             args.model = "bge-large-en"
         emit_degraded(args, probe, "tpu-unavailable")

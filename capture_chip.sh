@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# One-shot SERIAL chip capture — run when the TPU tunnel is healthy.
+#
+# Captures, in order (never concurrently: concurrent chip benchmarks wedged
+# the tunnel in r4), each with the wedge-proof probe bounding backend init:
+#   1. bench.py                      (bf16 headline, BASELINE metric)
+#   2. bench.py --quantize int8     (the 10x lever, VERDICT r5 item 2)
+#   3. bench_http.py                (HTTP-edge served-vs-direct, item 3)
+#   4. bench_all.py --quick         (configs 1-6 refresh, item 4)
+#   5. bench_scaling.py             (dp-scaling structure + projection)
+#
+# Results land in capture_r5/*.json(l); a COMPILE_CACHE_DIR is shared so
+# later scripts reuse the bge-large specializations compiled by earlier
+# ones.  Every script exits with a structured degraded record rather than
+# hanging if the tunnel wedges mid-capture.
+set -u
+cd "$(dirname "$0")"
+OUT=capture_r5
+mkdir -p "$OUT"
+export COMPILE_CACHE_DIR="${COMPILE_CACHE_DIR:-/tmp/lwc_xla_cache}"
+
+run() {
+  name=$1; shift
+  echo "== $name: $*" >&2
+  # hard outer bound so one hung phase cannot eat the whole window
+  timeout "${CAPTURE_PHASE_TIMEOUT:-1800}" "$@" \
+    > "$OUT/$name.jsonl" 2> "$OUT/$name.err"
+  rc=$?
+  echo "== $name rc=$rc" >&2
+  tail -1 "$OUT/$name.jsonl" 2>/dev/null >&2 || true
+}
+
+run bench           python bench.py
+run bench_int8      python bench.py --quantize int8
+run bench_http      python bench_http.py
+run bench_all       python bench_all.py --quick
+run bench_scaling   python bench_scaling.py
+echo "capture complete -> $OUT/" >&2

@@ -20,7 +20,7 @@ from ..clients.chat import AiohttpTransport, ApiBase, DefaultChatClient
 from ..clients.multichat import MultichatClient
 from ..clients.score import ScoreClient
 from ..weights import WeightFetchers
-from .config import Config, load_dotenv
+from .config import Config, enable_compile_cache, load_dotenv
 from .gateway import _parse_error_response, build_app
 
 FAKE_PORT = 5990
@@ -143,7 +143,9 @@ def _learn_handler(store, embedder, tables, lock):
     async def handler(request: web.Request):
         try:
             body = jsonutil.loads(await request.text())
-            if not isinstance(body, dict) or "model" not in body:
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            if "model" not in body:
                 raise ValueError("missing required field `model`")
             model = ModelBase.from_json_obj(
                 body["model"]
@@ -229,19 +231,6 @@ async def _fake_upstream(request: web.Request) -> web.StreamResponse:
     return resp
 
 
-def _enable_compile_cache(path: str) -> None:
-    """Persistent XLA compilation cache: warm restarts skip the
-    first-request compile (SURVEY §7 'cold-start/compile caching').
-    Must run before the first jit compilation."""
-    import jax
-
-    jax.config.update("jax_compilation_cache_dir", path)
-    # cache every specialization, not only slow ones — the serving loop
-    # has a handful of bucketed shapes and all of them matter cold
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-
-
 def _synthetic_params_allowed(allow_synthetic: bool) -> bool:
     import os
 
@@ -263,7 +252,7 @@ def build_embedder(config: Config, allow_synthetic: bool = False):
     ``allow_synthetic`` (set for --fake-upstream demo mode) or
     ``LWC_ALLOW_RANDOM_PARAMS=1``, and logged loudly even then."""
     if config.compile_cache_dir:
-        _enable_compile_cache(config.compile_cache_dir)
+        enable_compile_cache(config.compile_cache_dir)
     if not config.embedder_model:
         return None
     from ..models.configs import PRESETS

@@ -106,6 +106,21 @@ from typing import Optional
 from ..utils import env_truthy, jsonutil
 
 
+def enable_compile_cache(path: str) -> None:
+    """Persistent XLA compilation cache: warm restarts (and repeat bench
+    runs) skip the first-request compile (SURVEY §7 'cold-start/compile
+    caching').  Must run before the first jit compilation.  Lives here —
+    not serve/__main__ — so bench.py can use it without importing the
+    aiohttp entry-point chain."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every specialization, not only slow ones — the serving loop
+    # has a handful of bucketed shapes and all of them matter cold
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
 def _parse_warmup(raw) -> list:
     """"64x112,64x128" -> [(64, 112), (64, 128)].  Raises on malformed
     specs: a silently dropped warmup defeats its purpose."""

@@ -1620,9 +1620,7 @@ def test_compile_cache_dir_populates(tmp_path):
 
     from llm_weighted_consensus_tpu.models.configs import TEST_TINY
     from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
-    from llm_weighted_consensus_tpu.serve.__main__ import (
-        _enable_compile_cache,
-    )
+    from llm_weighted_consensus_tpu.serve.config import enable_compile_cache
 
     import jax
 
@@ -1639,7 +1637,7 @@ def test_compile_cache_dir_populates(tmp_path):
         )
     }
     try:
-        _enable_compile_cache(cache)
+        enable_compile_cache(cache)
         # a config shape nothing else in the suite compiles, so this is
         # a FRESH compilation (an in-memory jit cache hit writes nothing)
         novel = dataclasses.replace(TEST_TINY, hidden_size=96, num_heads=4)

@@ -564,17 +564,18 @@ def main() -> int:
     # bound backend init in a throwaway subprocess (same wedge-proofing as
     # bench.py): a wedged TPU tunnel HANGS init, and a hung bench_all
     # leaves no machine-readable round state
-    from bench import probe_backend
+    from bench import probe_or_exit
 
-    probe = probe_backend(args.probe_timeout)
-    if not probe["ok"]:
-        print(json.dumps({
-            "metric": "bench_all configs 1-6",
-            "value": None,
-            "error": f"tpu-unavailable: {probe['error']}",
-            "backend": probe.get("backend"),
-        }), flush=True)
-        return 2
+    probe_or_exit(
+        args.probe_timeout,
+        record={"metric": "bench_all configs 1-6", "value": None},
+    )
+    if os.environ.get("COMPILE_CACHE_DIR"):
+        from llm_weighted_consensus_tpu.serve.config import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache(os.environ["COMPILE_CACHE_DIR"])
     shared = _shared_embedders(q)
 
     n_runs = 1 if args.single_run else (2 if q else 3)

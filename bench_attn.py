@@ -80,15 +80,11 @@ def main():
     p.add_argument("--ks", default="8,16,32")
     p.add_argument("--probe-timeout", type=float, default=240.0)
     args = p.parse_args()
-    # wedge-proofing (bench.py pattern): bound backend init in a throwaway
-    # subprocess AFTER argparse (--help must stay instant); a wedged
-    # tunnel must fail fast with a parseable record, not hang
-    from bench import probe_backend
+    # wedge-proofing: shared bounded-probe preamble (bench.probe_or_exit)
+    # AFTER argparse so --help stays instant
+    from bench import probe_or_exit
 
-    _probe = probe_backend(args.probe_timeout)
-    if not _probe["ok"]:
-        print(json.dumps({"error": f"tpu-unavailable: {_probe['error']}"}))
-        return 2
+    probe_or_exit(args.probe_timeout)
 
     from llm_weighted_consensus_tpu.ops.attention import fused_attention_tiled
 

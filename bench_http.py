@@ -74,11 +74,20 @@ async def _start_service(model: str, window_ms: float, quantize: str = "none"):
     )
 
     fake_port = unused_port()
+    import os
+
     config = Config.from_env(
         {
             "EMBEDDER_MODEL": model,
             "BATCH_WINDOW_MS": str(window_ms),
             "EMBEDDER_QUANTIZE": quantize,
+            # share the capture run's persistent XLA cache (capture_chip.sh
+            # exports it so phase 3 reuses phase 1's specializations)
+            **(
+                {"COMPILE_CACHE_DIR": os.environ["COMPILE_CACHE_DIR"]}
+                if os.environ.get("COMPILE_CACHE_DIR")
+                else {}
+            ),
         }
     )
     app = build_service(

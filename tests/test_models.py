@@ -471,3 +471,25 @@ def test_bf16_golden_checkpoint_vote_agreement():
     cb = np.asarray(bf16.consensus_confidence(texts))
     assert cf.argmax() == cb.argmax()
     assert np.abs(cf - cb).max() < 0.05, (cf, cb)
+
+
+def test_bf16_reranker_preserves_reward_ordering():
+    """DeBERTa's three disentangled score tensors store in the activation
+    dtype (r4 cut); the bf16 RM must keep the reward ORDER and a close
+    softmax distribution vs the f32 path — executable bound on CPU, same
+    contract as test_quant.py's int8 RM test (ADVICE r4)."""
+    from llm_weighted_consensus_tpu.models.reranker import TpuReranker
+
+    kwargs = dict(config=DTINY, max_tokens=48, seed=5)
+    full = TpuReranker("deberta-test-tiny", **kwargs)
+    bf16 = TpuReranker("deberta-test-tiny", dtype=jnp.bfloat16, **kwargs)
+    texts = [
+        "the answer is four because two plus two",
+        "the answer is five because arithmetic",
+        "completely unrelated text about weather",
+    ]
+    cf, tf = full.rerank_confidence(texts, prompt="what is 2+2?")
+    cb, tb = bf16.rerank_confidence(texts, prompt="what is 2+2?")
+    assert tf == tb
+    assert list(np.argsort(cf)) == list(np.argsort(cb)), (cf, cb)
+    assert np.abs(cf - cb).max() < 0.05, (cf, cb)

@@ -181,3 +181,58 @@ def test_quantized_params_shard_on_dp_tp_mesh():
     shard_embedder(emb, mesh, tp=True)
     got = np.asarray(emb.consensus_confidence(texts))
     np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_quantized_bf16_combined_golden_checkpoint():
+    """int8 weights + bf16 activations COMBINED — the exact chip serving
+    mode (EMBEDDER_QUANTIZE=int8 on TPU runs bf16 activations) — on the
+    committed real-weights golden checkpoint: vote argmax preserved,
+    distribution close to the f32 full-precision path.  r5: the two modes
+    were only pinned separately (test_quant int8@f32, test_models
+    bf16@full-precision)."""
+    import json
+    import os
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures", "bge_micro")
+    if not os.path.isdir(fixture):
+        pytest.skip("golden checkpoint fixture missing")
+    from llm_weighted_consensus_tpu.models.loading import (
+        find_vocab,
+        load_params,
+    )
+    from llm_weighted_consensus_tpu.models.tokenizer import load_tokenizer
+
+    with open(os.path.join(fixture, "config.json")) as f:
+        cfg = json.load(f)
+    config = configs.BertConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=cfg["num_attention_heads"],
+        intermediate_size=cfg["intermediate_size"],
+        max_position_embeddings=cfg["max_position_embeddings"],
+        type_vocab_size=cfg["type_vocab_size"],
+        layer_norm_eps=cfg["layer_norm_eps"],
+    )
+    params = load_params(fixture, config)
+    tok = load_tokenizer(find_vocab(fixture))
+    kwargs = dict(config=config, tokenizer=tok, max_tokens=64)
+    full = TpuEmbedder("bge-micro", params=params, **kwargs)
+    both = TpuEmbedder(
+        "bge-micro", params=params, quantize="int8",
+        dtype=jnp.bfloat16, **kwargs
+    )
+    texts = [
+        "paris is the capital of france",
+        "the capital of france is paris",
+        "paris, france's capital city",
+        "bananas are curved and yellow",
+    ]
+    ef = np.asarray(full.embed_texts(texts), np.float32)
+    eb = np.asarray(both.embed_texts(texts), np.float32)
+    cos = (ef * eb).sum(axis=1)
+    assert cos.min() > 0.98, cos
+    cf = np.asarray(full.consensus_confidence(texts))
+    cb = np.asarray(both.consensus_confidence(texts))
+    assert cf.argmax() == cb.argmax()
+    assert np.abs(cf - cb).max() < 0.1, (cf, cb)

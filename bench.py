@@ -284,6 +284,19 @@ def probe_or_exit(timeout_s: float, record: dict = None) -> str:
     return probe["backend"]
 
 
+def maybe_enable_compile_cache() -> None:
+    """Honor COMPILE_CACHE_DIR (the serving knob) in a bench process —
+    one definition for every bench entry point."""
+    import os
+
+    if os.environ.get("COMPILE_CACHE_DIR"):
+        from llm_weighted_consensus_tpu.serve.config import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache(os.environ["COMPILE_CACHE_DIR"])
+
+
 def emit_degraded(args, probe: dict, stage: str) -> None:
     """The ONE JSON line for a round where the chip was unreachable or the
     bench died — parsed is never null, the round state stays
@@ -352,15 +365,10 @@ def run_bench(args, backend: str) -> int:
 
     from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
 
-    if os.environ.get("COMPILE_CACHE_DIR"):
-        # same persistent-XLA-cache knob serving honors: repeat bench runs
-        # (and the driver's round-end capture) skip the tens-of-seconds
-        # bge-large specialization compiles
-        from llm_weighted_consensus_tpu.serve.config import (
-            enable_compile_cache,
-        )
-
-        enable_compile_cache(os.environ["COMPILE_CACHE_DIR"])
+    # same persistent-XLA-cache knob serving honors: repeat bench runs
+    # (and the driver's round-end capture) skip the tens-of-seconds
+    # bge-large specialization compiles
+    maybe_enable_compile_cache()
 
     dtype = jnp.bfloat16 if backend == "tpu" else jnp.float32
 

@@ -570,12 +570,9 @@ def main() -> int:
         args.probe_timeout,
         record={"metric": "bench_all configs 1-6", "value": None},
     )
-    if os.environ.get("COMPILE_CACHE_DIR"):
-        from llm_weighted_consensus_tpu.serve.config import (
-            enable_compile_cache,
-        )
+    from bench import maybe_enable_compile_cache
 
-        enable_compile_cache(os.environ["COMPILE_CACHE_DIR"])
+    maybe_enable_compile_cache()
     shared = _shared_embedders(q)
 
     n_runs = 1 if args.single_run else (2 if q else 3)

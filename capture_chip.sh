@@ -32,8 +32,10 @@ run() {
   if [ $rc -ne 0 ] && ! tail -1 "$OUT/$name.jsonl" 2>/dev/null | grep -q '"error"'; then
     # killed mid-run (e.g. tunnel wedged AFTER a healthy probe): the
     # bench could not emit its own degraded record, so write one here —
-    # phase output must be machine-readable in every outcome
-    echo "{\"error\": \"capture-phase-killed rc=$rc (mid-run wedge or crash)\", \"phase\": \"$name\", \"value\": null}" >> "$OUT/$name.jsonl"
+    # phase output must be machine-readable in every outcome.  The
+    # leading newline guards against a partial line killed mid-write
+    # (the record must never glue onto a truncated fragment).
+    printf '\n{"error": "capture-phase-killed rc=%s (mid-run wedge or crash)", "phase": "%s", "value": null}\n' "$rc" "$name" >> "$OUT/$name.jsonl"
   fi
   echo "== $name rc=$rc" >&2
   tail -1 "$OUT/$name.jsonl" 2>/dev/null >&2 || true

@@ -124,3 +124,41 @@ def load_checkpoint(path: str, like=None):
         if like is not None:
             return ckptr.restore(path, like)
         return ckptr.restore(path)
+
+
+def save_train_state(path: str, params, opt_state, step: int) -> None:
+    """Checkpoint the FULL training state — params, optimizer state
+    (adamw moments/counts), and step — so an interrupted run resumes
+    bit-comparably.  Params-only checkpoints (``save_checkpoint``) are
+    the serving artifact; resuming training from one silently resets the
+    Adam moments and changes the trajectory."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            path,
+            {
+                "params": params,
+                "opt_state": opt_state,
+                "step": jnp.asarray(step, jnp.int32),
+            },
+            force=True,
+        )
+
+
+def load_train_state(path: str, like_params, like_opt_state):
+    """Restore (params, opt_state, step) saved by ``save_train_state``.
+    ``like_*`` provide the pytree structure (build them exactly as the
+    original run did: init_params + optimizer.init)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(
+            path,
+            {
+                "params": like_params,
+                "opt_state": like_opt_state,
+                "step": jnp.asarray(0, jnp.int32),
+            },
+        )
+    return state["params"], state["opt_state"], int(state["step"])

@@ -264,3 +264,52 @@ def test_force_cpu_env_scrubs_tunnel_plugin():
     assert "--xla_foo=1" in out["XLA_FLAGS"]
     assert "--xla_force_host_platform_device_count=8" in out["XLA_FLAGS"]
     assert out["XLA_FLAGS"].count("device_count") == 1
+
+
+def test_train_resume_equivalence(tmp_path):
+    """Checkpoint/resume depth (SURVEY §5): an interrupted contrastive run
+    resumed from the FULL train state (params + adam moments + step)
+    continues with the same losses as the uninterrupted run — params-only
+    resume would reset the moments and diverge."""
+    from llm_weighted_consensus_tpu import train
+
+    config = TEST_TINY
+    optimizer = train.make_optimizer(lr=1e-3)
+    rng = np.random.default_rng(7)
+    b, s = 4, 16
+    batches = [
+        (
+            jnp.asarray(rng.integers(3, config.vocab_size, (b, s)), jnp.int32),
+            jnp.asarray(rng.integers(3, config.vocab_size, (b, s)), jnp.int32),
+        )
+        for _ in range(5)
+    ]
+    ones = jnp.ones((b, s), jnp.int32)
+
+    def run(params, opt_state, batch_list):
+        losses = []
+        for q, p in batch_list:
+            params, opt_state, loss = train.contrastive_train_step(
+                params, opt_state, q, ones, p, ones, config, optimizer
+            )
+            losses.append(float(loss))
+        return params, opt_state, losses
+
+    # uninterrupted: 5 steps straight through
+    params0 = bert.init_params(jax.random.PRNGKey(3), config)
+    _, _, straight = run(params0, optimizer.init(params0), batches)
+
+    # interrupted: 3 steps, full-state checkpoint, fresh process-analog
+    # restore (like-trees rebuilt from scratch), 2 more steps
+    params0 = bert.init_params(jax.random.PRNGKey(3), config)
+    params_a, opt_a, first3 = run(params0, optimizer.init(params0), batches[:3])
+    path = str(tmp_path / "train_ckpt")
+    train.save_train_state(path, params_a, opt_a, step=3)
+
+    like_params = bert.init_params(jax.random.PRNGKey(9), config)  # other seed
+    like_opt = optimizer.init(like_params)
+    params_b, opt_b, step = train.load_train_state(path, like_params, like_opt)
+    assert step == 3
+    _, _, last2 = run(params_b, opt_b, batches[3:])
+
+    np.testing.assert_allclose(first3 + last2, straight, rtol=1e-5)

@@ -139,8 +139,14 @@ def worker_main() -> None:
     else:
         mesh = Mesh(np.array(devices), ("dp",))
 
-    votes_np = np.array(VOTES, np.float32)
-    weights_np = np.array(WEIGHTS, np.float32)
+    # dp must divide the row count; replicating the WHOLE vote table
+    # scales numerator and denominator of the normalized tally equally,
+    # so expected_confidence() is unchanged at any group size
+    reps = 1
+    while (len(VOTES) * reps) % num:
+        reps += 1
+    votes_np = np.array(VOTES * reps, np.float32)
+    weights_np = np.array(WEIGHTS * reps, np.float32)
 
     def globalize(arr, spec):
         sharding = NamedSharding(mesh, spec)

@@ -1,0 +1,170 @@
+"""Sustained mixed-load soak of the full service (SURVEY §5 race-detection
+/ failure-recovery depth): ~15 s of concurrent consensus, embeddings,
+score and multichat-stream traffic through the real aiohttp app + batcher,
+asserting
+
+* every response stays well-formed (status 200, distributions sum to 1,
+  SSE streams end in [DONE]),
+* the device-dispatch metrics record zero errors,
+* the archive FIFO cap holds under continuous ARCHIVE_WRITE, and
+* peak RSS growth stays bounded (a leak in the batcher's buffer reuse,
+  the archive, or stream teardown compounds fast at this request rate).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+pytest.importorskip("jax")
+
+from llm_weighted_consensus_tpu.serve import Config  # noqa: E402
+from llm_weighted_consensus_tpu.serve.gateway import METRICS_KEY  # noqa: E402
+
+SOAK_SECONDS = 15.0
+ARCHIVE_CAP = 64
+
+
+def build_app(fake_port: int):
+    from llm_weighted_consensus_tpu.serve.__main__ import (
+        ARCHIVE_KEY,
+        build_service,
+    )
+
+    config = Config.from_env(
+        {
+            "OPENAI_API_BASE": "https://up.example",
+            "OPENAI_API_KEY": "k",
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_MAX_TOKENS": "32",
+            "ARCHIVE_WRITE": "1",
+            "ARCHIVE_STREAMING": "1",
+            "ARCHIVE_MAX_COMPLETIONS": str(ARCHIVE_CAP),
+        }
+    )
+    app = build_service(
+        config, fake_upstream=True, fake_upstream_port=fake_port
+    )
+    return app, ARCHIVE_KEY
+
+
+def test_mixed_load_soak():
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer, unused_port
+
+    from llm_weighted_consensus_tpu.serve.__main__ import _fake_upstream
+
+    fake_port = unused_port()
+    app, archive_key = build_app(fake_port)
+
+    async def run():
+        # real fake-upstream on a real socket (the serve __main__ wiring),
+        # so the score path exercises the full judge round-trip + archive
+        fake_app = web.Application()
+        fake_app.router.add_post("/v1/chat/completions", _fake_upstream)
+        fake_runner = web.AppRunner(fake_app)
+        await fake_runner.setup()
+        await web.TCPSite(fake_runner, "127.0.0.1", fake_port).start()
+
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await soak(client)
+        finally:
+            # teardown must run even when a soak assertion propagates,
+            # or nine still-running loops leak sockets + pending tasks
+            await client.close()
+            await fake_runner.cleanup()
+
+    async def soak(client):
+        stats = {"requests": 0, "errors": 0, "score": 0}
+        deadline = asyncio.get_running_loop().time() + SOAK_SECONDS
+
+        async def consensus_loop(i):
+            texts = [f"candidate {i} says {j}" for j in range(4)]
+            while asyncio.get_running_loop().time() < deadline:
+                resp = await client.post(
+                    "/consensus", json={"input": texts}
+                )
+                text = await resp.text()
+                assert resp.status == 200, text[:300]
+                body = json.loads(text)
+                assert abs(sum(body["confidence"]) - 1.0) < 1e-3
+                stats["requests"] += 1
+
+        async def embeddings_loop(i):
+            while asyncio.get_running_loop().time() < deadline:
+                resp = await client.post(
+                    "/embeddings",
+                    json={
+                        "model": "test-tiny",
+                        "input": [f"text {i} a", f"text {i} b"],
+                    },
+                )
+                text = await resp.text()
+                assert resp.status == 200, text[:300]
+                body = json.loads(text)
+                assert len(body["data"]) == 2
+                stats["requests"] += 1
+
+        async def bad_input_loop():
+            # adversarial traffic interleaved with good: must 4xx cleanly,
+            # never disturb the healthy loops
+            while asyncio.get_running_loop().time() < deadline:
+                resp = await client.post("/consensus", json={"input": 7})
+                assert resp.status == 400
+                stats["errors"] += 1
+                await asyncio.sleep(0.01)
+
+        async def score_loop(i):
+            body = {
+                "stream": True,
+                "messages": [{"role": "user", "content": f"pick one ({i})"}],
+                "model": {"llms": [{"model": "judge-a"}]},
+                "choices": ["first answer", "second answer"],
+            }
+            while asyncio.get_running_loop().time() < deadline:
+                resp = await client.post("/score/completions", json=body)
+                text = await resp.text()
+                assert resp.status == 200, text[:200]
+                assert text.rstrip().endswith("data: [DONE]")
+                stats["score"] += 1
+
+        await asyncio.gather(
+            *(consensus_loop(i) for i in range(4)),
+            *(embeddings_loop(i) for i in range(2)),
+            *(score_loop(i) for i in range(2)),
+            bad_input_loop(),
+        )
+
+        # the archive kept every scored completion up to the FIFO cap
+        store = app[archive_key]
+        archived = len(store._score)
+        assert 0 < archived <= ARCHIVE_CAP, archived
+
+        metrics = app[METRICS_KEY].snapshot()
+        for name, series in metrics["series"].items():
+            if name.startswith("device:"):
+                assert series["errors"] == 0, (name, series)
+        return stats
+
+    rss_before = _vm_rss_kb()
+    stats = asyncio.run(run())
+    rss_after = _vm_rss_kb()
+
+    assert stats["requests"] > 50, stats  # the soak actually soaked
+    assert stats["score"] > 5, stats
+    assert stats["errors"] > 10, stats
+    # CURRENT RSS (not ru_maxrss, a process-lifetime high-water mark that
+    # an earlier heavy test would have already raised past anything the
+    # soak could add, vacuously passing); generous bound — catches
+    # unbounded leaks, not allocator noise
+    assert rss_after - rss_before < 300_000, (rss_before, rss_after)
+
+
+def _vm_rss_kb() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    raise RuntimeError("VmRSS not found")

@@ -6,21 +6,27 @@
 #   1. bench.py                      (bf16 headline, BASELINE metric)
 #   2. bench.py --quantize int8     (the 10x lever, VERDICT r5 item 2)
 #   3. bench_http.py                (HTTP-edge served-vs-direct, item 3)
-#   4. bench_all.py --quick         (configs 1-6 refresh, item 4)
+#   4. bench_all.py                 (configs 1-6 refresh, item 4;
+#                                    --quick unless CAPTURE_FULL=1)
 #   5. bench_scaling.py             (dp-scaling structure + projection)
 #
-# Results land in capture_r5/*.json(l); a COMPILE_CACHE_DIR is shared and
-# every phase honors it (bench.py/bench_all directly, bench_http via its
-# service config), so later phases reuse the bge-large specializations
-# compiled by earlier ones.  The probes bound backend INIT; a wedge that
-# strikes MID-RUN (after a healthy probe) is caught by the per-phase
-# timeout below, and run() then appends a structured degraded record so
-# the phase output is machine-readable either way.
+# Usage: bash capture_chip.sh [outdir]   (default capture_r5; a relative
+# outdir resolves against the CALLER's cwd).  Writes <outdir>/<phase>.jsonl
+# + <phase>.err per phase.  A COMPILE_CACHE_DIR is shared and every phase
+# honors it, so later phases reuse the bge-large specializations compiled
+# by earlier ones.  The probes bound backend INIT; a wedge that strikes
+# MID-RUN (after a healthy probe) is caught by the per-phase timeout
+# (CAPTURE_PHASE_TIMEOUT, default 1800 s), and run() then appends a
+# structured degraded record so the phase output is machine-readable
+# either way.  Exit status: 0 only if EVERY phase succeeded; 1 if any
+# phase degraded/failed (CI can gate on it).
 set -u
+OUT="${1:-capture_r5}"
+case "$OUT" in /*) ;; *) OUT="$PWD/$OUT" ;; esac
 cd "$(dirname "$0")"
-OUT=capture_r5
 mkdir -p "$OUT"
 export COMPILE_CACHE_DIR="${COMPILE_CACHE_DIR:-/tmp/lwc_xla_cache}"
+WORST=0
 
 run() {
   name=$1; shift
@@ -29,21 +35,28 @@ run() {
   timeout "${CAPTURE_PHASE_TIMEOUT:-1800}" "$@" \
     > "$OUT/$name.jsonl" 2> "$OUT/$name.err"
   rc=$?
-  if [ $rc -ne 0 ] && ! tail -1 "$OUT/$name.jsonl" 2>/dev/null | grep -q '"error"'; then
-    # killed mid-run (e.g. tunnel wedged AFTER a healthy probe): the
-    # bench could not emit its own degraded record, so write one here —
-    # phase output must be machine-readable in every outcome.  The
-    # leading newline guards against a partial line killed mid-write
-    # (the record must never glue onto a truncated fragment).
-    printf '\n{"error": "capture-phase-killed rc=%s (mid-run wedge or crash)", "phase": "%s", "value": null}\n' "$rc" "$name" >> "$OUT/$name.jsonl"
+  if [ $rc -ne 0 ]; then
+    WORST=1
+    if ! tail -1 "$OUT/$name.jsonl" 2>/dev/null | grep -q '"error"'; then
+      # killed mid-run (e.g. tunnel wedged AFTER a healthy probe): the
+      # bench could not emit its own degraded record, so write one here —
+      # phase output must be machine-readable in every outcome.  The
+      # leading newline guards against a partial line killed mid-write
+      # (the record must never glue onto a truncated fragment).
+      printf '\n{"error": "capture-phase-killed rc=%s (mid-run wedge or crash)", "phase": "%s", "value": null}\n' "$rc" "$name" >> "$OUT/$name.jsonl"
+    fi
   fi
   echo "== $name rc=$rc" >&2
   tail -1 "$OUT/$name.jsonl" 2>/dev/null >&2 || true
 }
 
+if [ -n "${CAPTURE_FULL:-}" ]; then ALL_ARGS=""; else ALL_ARGS="--quick"; fi
+
 run bench           python bench.py
 run bench_int8      python bench.py --quantize int8
 run bench_http      python bench_http.py
-run bench_all       python bench_all.py --quick
+# shellcheck disable=SC2086
+run bench_all       python bench_all.py $ALL_ARGS
 run bench_scaling   python bench_scaling.py
-echo "capture complete -> $OUT/" >&2
+echo "capture complete -> $OUT/ (worst=$WORST)" >&2
+exit "$WORST"

@@ -1,31 +1,14 @@
 #!/usr/bin/env bash
-# The full chip measurement session in one command (run on the machine
-# with the real TPU attached, from the repo root):
+# The FULL chip measurement session in one command — delegates to the
+# repo-root capture_chip.sh (per-phase timeouts, guaranteed degraded
+# records on a wedged tunnel, shared persistent XLA compile cache) with
+# full-fidelity bench_all (CAPTURE_FULL=1: 100 requests, median-of-3).
 #
-#   bash examples/bench_round.sh [outdir]
+#   bash examples/bench_round.sh [outdir]   # default ./bench_out,
+#                                           # relative to YOUR cwd
 #
-# Produces one JSON-lines file per harness under OUTDIR (default
-# ./bench_out).  Order matters: the headline first (freshest tunnel),
-# then the int8 twin, the HTTP edge, and the five BASELINE configs.
-# NEVER run two of these concurrently — simultaneous chip benchmarks
-# wedged the tunnel in r4 (DESIGN.md).
-set -euo pipefail
-OUT="${1:-bench_out}"
-mkdir -p "$OUT"
-
-echo "== headline (bf16) ==" >&2
-python bench.py | tee "$OUT/bench_headline.json"
-
-echo "== headline (int8 W8A8) ==" >&2
-python bench.py --quantize int8 | tee "$OUT/bench_int8.json"
-
-echo "== HTTP edge (served vs direct, N=64) ==" >&2
-python bench_http.py | tee "$OUT/bench_http.json"
-
-echo "== BASELINE configs 1-5 + learning-effect evidence ==" >&2
-python bench_all.py | tee "$OUT/bench_all.json"
-
-echo "== dp scaling + load test (virtual mesh; chip not required) ==" >&2
-python bench_scaling.py | tee "$OUT/bench_scaling.json"
-
-echo "done: $OUT" >&2
+# Output naming (changed from the pre-r5 inline version): one
+# <outdir>/<phase>.jsonl + <phase>.err per phase, phases = bench,
+# bench_int8, bench_http, bench_all, bench_scaling.  Exits nonzero if
+# any phase degraded.
+CAPTURE_FULL=1 exec bash "$(dirname "$0")/../capture_chip.sh" "${1:-bench_out}"

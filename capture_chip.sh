@@ -50,7 +50,7 @@ run() {
   tail -1 "$OUT/$name.jsonl" 2>/dev/null >&2 || true
 }
 
-if [ -n "${CAPTURE_FULL:-}" ]; then ALL_ARGS=""; else ALL_ARGS="--quick"; fi
+if [ "${CAPTURE_FULL:-}" = 1 ]; then ALL_ARGS=""; else ALL_ARGS="--quick"; fi
 
 run bench           python bench.py
 run bench_int8      python bench.py --quantize int8

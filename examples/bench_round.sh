@@ -7,6 +7,10 @@
 #   bash examples/bench_round.sh [outdir]   # default ./bench_out,
 #                                           # relative to YOUR cwd
 #
+# NEVER run two chip benchmarks concurrently — simultaneous chip
+# benchmarks wedged the axon tunnel in r4 (DESIGN.md); capture runs its
+# phases serially for exactly this reason.
+#
 # Output naming (changed from the pre-r5 inline version): one
 # <outdir>/<phase>.jsonl + <phase>.err per phase, phases = bench,
 # bench_int8, bench_http, bench_all, bench_scaling.  Exits nonzero if

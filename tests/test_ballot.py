@@ -315,3 +315,54 @@ def test_leaf_branch_of_matches_walk_for_stripped_keys():
             stripped = key[1:-1]  # find_key's without_ticks form
             branch = PrefixTree.leaf_branch_of(pairs, stripped)
             assert branch == tree.walk(key), (n, limit, key)
+
+
+def test_regex_patterns_match_naive_reference_construction():
+    """The optimized pattern construction (factored backtick prefix,
+    non-capturing) must produce byte-identical find_key results to the
+    reference's naive per-key-group alternation, on adversarial contents:
+    overlapping keys, restated keys, eaten ticks, key-free text
+    (tree.py::regex_patterns docstring)."""
+    import re
+
+    from llm_weighted_consensus_tpu.ballot.vote import find_key
+
+    rng = random.Random(5)
+    for n in (2, 20, 21, 64, 400):
+        tree = PrefixTree.build(rng, n, 20)
+        pairs = tree.key_indices(rng)
+        keys = [k for k, _ in pairs]
+        wt, wot = PrefixTree.regex_patterns(keys)
+        naive_wt = "|".join(f"({k})" for k in keys)
+        naive_wot = "|".join(f"({k[1:-1]})" for k in keys)
+
+        def naive_find(content):
+            for pat in (naive_wt, naive_wot):
+                last = None
+                for m in re.finditer(pat, content):
+                    last = m
+                if last is not None:
+                    return last.group(0)
+            return None
+
+        k = lambda i: keys[i % len(keys)]
+        contents = [
+            "no keys here at all",
+            f"I pick {k(0)}",
+            f"{k(1)} then later {k(2)}, final: {k(0)}",
+            f"ticks eaten: {k(3)[1:-1]}",
+            # overlapping backticks: adjacent keys share delimiters
+            k(0) + k(1) + k(0),
+            "`" + k(2),  # stray tick before a real key
+            ("padding " * 50) + keys[-1],
+        ]
+        # plus fuzzed interleavings
+        for _ in range(10):
+            parts = rng.choices(
+                keys + ["lorem ", "`", "``", "ipsum`X`", " "], k=12
+            )
+            contents.append("".join(parts))
+        for content in contents:
+            assert find_key(content, wt, wot) == naive_find(content), (
+                n, content
+            )

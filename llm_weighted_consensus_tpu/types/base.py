@@ -305,6 +305,76 @@ def _encode_dynamic(value):
 # ---------------------------------------------------------------------------
 
 
+def _class_plan(cls, attr: str, build):
+    """Per-class cache stored on the class itself (``cls.__dict__`` probe,
+    NOT getattr: a subclass must not inherit its base's plan), so plans
+    are garbage-collected with their class and cost one dict lookup per
+    call.  Field specs are frozen at class-definition time (everything
+    here re-derives what the hot methods used to pull from
+    ``dataclasses.fields`` metadata on every call — mappingproxy lookups
+    measured as a top host cost in a profiled scored request; push/clone/
+    to_json_obj run per chunk per judge)."""
+    plan = cls.__dict__.get(attr)
+    if plan is None:
+        plan = build(cls)
+        setattr(cls, attr, plan)
+    return plan
+
+
+def _build_names(cls):
+    return tuple(f.name for f in dataclasses.fields(cls))
+
+
+def _build_push(cls):
+    return tuple(
+        (
+            f.name,
+            f.metadata.get("merge", FIRST),
+            f.metadata.get("key", "index"),
+        )
+        for f in dataclasses.fields(cls)
+    )
+
+
+def _field_spec(cls, f):
+    try:
+        return f.metadata["spec"]
+    except KeyError:
+        raise TypeError(
+            f"{cls.__name__}.{f.name} was declared without the field() "
+            "helper (no codec spec in metadata) — it can be pushed/cloned "
+            "but not (de)serialized"
+        ) from None
+
+
+def _build_encode(cls):
+    return tuple(
+        (
+            f.name,
+            f.metadata.get("json_name") or f.name,
+            f.metadata.get("skip_if_none", True),
+            _field_spec(cls, f),
+        )
+        for f in dataclasses.fields(cls)
+    )
+
+
+def _build_decode(cls):
+    return tuple(
+        (
+            f.name,
+            f.metadata.get("json_name") or f.name,
+            _field_spec(cls, f),
+            bool(f.metadata.get("required"))
+            or (
+                f.default is dataclasses.MISSING
+                and f.default_factory is dataclasses.MISSING
+            ),
+        )
+        for f in dataclasses.fields(cls)
+    )
+
+
 class Struct:
     """Base for all wire types; subclasses are auto-dataclassed."""
 
@@ -316,13 +386,12 @@ class Struct:
 
     def to_json_obj(self) -> dict:
         out: dict[str, Any] = {}
-        for f in dataclasses.fields(self):
-            meta = f.metadata
-            value = getattr(self, f.name)
-            if value is None and meta.get("skip_if_none", True):
+        encode_plan = _class_plan(type(self), "_lwc_encode_plan", _build_encode)
+        for attr, name, skip_if_none, spec in encode_plan:
+            value = getattr(self, attr)
+            if value is None and skip_if_none:
                 continue
-            name = meta.get("json_name") or f.name
-            out[name] = _encode(meta["spec"], value)
+            out[name] = _encode(spec, value)
         return out
 
     def to_json(self, *, pretty: bool = False) -> str:
@@ -334,19 +403,15 @@ class Struct:
             raise SchemaError(path, f"expected object, got {type(obj).__name__}")
         kwargs = {}
         # unknown JSON fields are ignored, matching serde's default behavior
-        for f in dataclasses.fields(cls):
-            meta = f.metadata
-            name = meta.get("json_name") or f.name
-            sub_path = f"{path}.{name}" if path else name
+        decode_plan = _class_plan(cls, "_lwc_decode_plan", _build_decode)
+        for attr, name, spec, required in decode_plan:
             if name in obj and obj[name] is not None:
-                kwargs[f.name] = _decode(meta["spec"], obj[name], sub_path)
-            else:
-                if meta.get("required") or (
-                    f.default is dataclasses.MISSING
-                    and f.default_factory is dataclasses.MISSING
-                ):
-                    raise SchemaError(sub_path, "missing required field")
-                # default applies
+                sub_path = f"{path}.{name}" if path else name
+                kwargs[attr] = _decode(spec, obj[name], sub_path)
+            elif required:
+                sub_path = f"{path}.{name}" if path else name
+                raise SchemaError(sub_path, "missing required field")
+            # else: default applies
         return cls(**kwargs)
 
     @classmethod
@@ -361,29 +426,28 @@ class Struct:
             raise TypeError(
                 f"cannot push {type(other).__name__} into {type(self).__name__}"
             )
-        for f in dataclasses.fields(self):
-            strategy = f.metadata.get("merge", FIRST)
+        push_plan = _class_plan(type(self), "_lwc_push_plan", _build_push)
+        for name, strategy, key in push_plan:
             if strategy == KEEP:
                 continue
-            mine = getattr(self, f.name)
-            theirs = getattr(other, f.name)
+            mine = getattr(self, name)
+            theirs = getattr(other, name)
             if theirs is None:
                 continue
             if mine is None:
-                setattr(self, f.name, _clone(theirs))
+                setattr(self, name, _clone(theirs))
                 continue
             if strategy == FIRST:
                 pass  # first write wins
             elif strategy == CONCAT:
-                setattr(self, f.name, mine + theirs)
+                setattr(self, name, mine + theirs)
             elif strategy == ADD:
-                setattr(self, f.name, mine + theirs)
+                setattr(self, name, mine + theirs)
             elif strategy == EXTEND:
                 mine.extend(_clone(v) for v in theirs)
             elif strategy == NESTED:
                 mine.push(theirs)
             elif strategy == KEYED:
-                key = f.metadata.get("key", "index")
                 _push_keyed(mine, theirs, key)
             else:
                 raise ValueError(f"unknown merge strategy {strategy!r}")
@@ -406,13 +470,24 @@ def _push_keyed(mine: list, theirs: list, key: str) -> None:
 
 
 def _clone(value):
+    # exact-class checks first: the overwhelmingly common case is a leaf
+    # (str/int/Decimal/None), which should fall through with two pointer
+    # compares instead of three isinstance() calls (this function is the
+    # top host cost of a profiled scored request — per-judge isolation
+    # clones run per judge per chunk)
+    cls = value.__class__
+    if cls is list:
+        return [_clone(v) for v in value]
+    if cls is dict:
+        return {k: _clone(v) for k, v in value.items()}
     if isinstance(value, Struct):
-        return type(value)(
+        return cls(
             **{
-                f.name: _clone(getattr(value, f.name))
-                for f in dataclasses.fields(value)
+                name: _clone(getattr(value, name))
+                for name in _class_plan(cls, "_lwc_field_names", _build_names)
             }
         )
+    # subclasses of the containers (rare; exact classes took the fast path)
     if isinstance(value, list):
         return [_clone(v) for v in value]
     if isinstance(value, dict):

@@ -36,7 +36,14 @@ _ESCAPE_MAP = {
 }
 
 
-def _escape_string(s: str) -> str:
+def _escape_string_py(s: str) -> str:
+    """Reference implementation of the escaping contract (backslash,
+    quote, the five short escapes, \\u%04x for other controls, everything
+    else verbatim).  Kept as the spec + fallback; the live path below is
+    stdlib's C ``encode_basestring``, which implements the same mapping
+    (fuzz-pinned byte-identical in tests/test_jsonutil.py) ~7x faster —
+    it was the top host hotspot in a profiled scored request (per-judge
+    pretty ballot serialization escapes ~1k strings per request)."""
     out = []
     for ch in s:
         esc = _ESCAPE_MAP.get(ch)
@@ -47,6 +54,12 @@ def _escape_string(s: str) -> str:
         else:
             out.append(ch)
     return '"' + "".join(out) + '"'
+
+
+try:
+    from json.encoder import encode_basestring as _escape_string
+except ImportError:  # pragma: no cover - stdlib always has it
+    _escape_string = _escape_string_py
 
 
 def _format_decimal(d: Decimal) -> str:

@@ -159,3 +159,35 @@ def test_invalid_key_type_raises_typeerror_both_paths():
             pass
         else:
             raise AssertionError("tuple key did not raise")
+
+
+def test_escape_string_c_path_matches_reference_escaper():
+    """The live escaper (stdlib C encode_basestring) must stay
+    byte-identical to the in-repo reference implementation across
+    controls, quotes, backslashes, astral planes, and fuzz."""
+    import random as _random
+
+    rng = _random.Random(0)
+    cases = [
+        "", "plain", 'quote"back\\slash', "\b\f\n\r\t",
+        "".join(chr(i) for i in range(0x20)),
+        "unicode é中\U0001f600", 'mixed\x01"\\\nend',
+    ]
+    for _ in range(500):
+        cases.append(
+            "".join(
+                chr(
+                    rng.choice(
+                        [
+                            rng.randrange(0, 0x20),
+                            rng.randrange(0x20, 0x7F),
+                            rng.randrange(0x80, 0x3000),
+                            rng.randrange(0x10000, 0x10100),
+                        ]
+                    )
+                )
+                for _ in range(rng.randrange(0, 40))
+            )
+        )
+    for c in cases:
+        assert jsonutil._escape_string(c) == jsonutil._escape_string_py(c), repr(c)

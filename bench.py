@@ -31,7 +31,13 @@ field makes explicit.
 Prints ONE JSON line.
 
 Flags: --model (default bge-large-en), --n (64), --seq (128),
---requests (100), --latency-requests (50), --no-pipeline.
+--requests (100), --latency-requests (50), --no-pipeline,
+--quantize {none,int8} (W8A8 serving mode, reported with an inline
+accuracy delta vs a same-seed unquantized twin), --probe-timeout (bound
+on the throwaway backend-init probe; on expiry ONE degraded JSON record
+is emitted instead of hanging — a wedged TPU tunnel hangs, not raises),
+--profile DIR (xprof trace of the throughput loop).  COMPILE_CACHE_DIR
+is honored (persistent XLA cache across runs).
 """
 
 from __future__ import annotations

@@ -21,6 +21,15 @@ inside the timed path.  Three served endpoints:
 Prints ONE JSON line per endpoint: {"endpoint", "value", "unit",
 "p50_ms", ...}.  Flags: --model (default bge-large-en on TPU, test-tiny
 elsewhere), --n, --requests, --concurrency, --quick.
+
+``--cache {off,cold,warm}`` replaces the endpoint trio with the consensus
+result cache scenario (cache/): the SAME score request replayed K times
+against a service started with SCORE_CACHE_TTL set (except ``off``),
+reporting hit vs miss p50/p95 plus the served /metrics ``score_cache``
+counters in the same one-JSON-line format.  ``cold`` starts the repeat
+run on an empty cache (first request is the miss that fills it; the
+in-flight rest collapse onto it); ``warm`` primes the entry untimed
+first so every timed request is a pure hit.
 """
 
 from __future__ import annotations
@@ -61,7 +70,17 @@ def _percentiles(lat_ms: list) -> dict:
     }
 
 
-async def _start_service(model: str, window_ms: float, quantize: str = "none"):
+def _quantile(lat_ms: list, q: float) -> float:
+    lat = sorted(lat_ms)
+    return round(lat[min(len(lat) - 1, int(len(lat) * q))], 2)
+
+
+async def _start_service(
+    model: str,
+    window_ms: float,
+    quantize: str = "none",
+    cache_ttl_sec: float = 0.0,
+):
     """The real service on real localhost TCP sockets (fake upstream
     included), exactly as ``python -m ...serve --fake-upstream`` wires it."""
     from aiohttp import web
@@ -86,6 +105,11 @@ async def _start_service(model: str, window_ms: float, quantize: str = "none"):
             **(
                 {"COMPILE_CACHE_DIR": os.environ["COMPILE_CACHE_DIR"]}
                 if os.environ.get("COMPILE_CACHE_DIR")
+                else {}
+            ),
+            **(
+                {"SCORE_CACHE_TTL": str(cache_ttl_sec)}
+                if cache_ttl_sec > 0
                 else {}
             ),
         }
@@ -307,17 +331,109 @@ async def bench_multichat_endpoint(
     )
 
 
+def _score_body(content: str) -> str:
+    return json.dumps(
+        {
+            "stream": True,
+            "messages": [{"role": "user", "content": content}],
+            "model": {"llms": [{"model": "fake-judge"}]},
+            "choices": ["candidate a", "candidate b"],
+        }
+    )
+
+
+async def bench_score_cache(session, base, requests, concurrency, mode):
+    """Hit vs miss economics of the consensus result cache.
+
+    Two timed samples through /score/completions: K DISTINCT bodies
+    (every request a cache miss — the full ballot round-trip), then the
+    SAME body K times (hits after the first fill).  ``warm`` primes the
+    repeated body untimed so the hit sample is pure; ``cold`` lets the
+    first timed repeat be the miss that fills the entry (concurrent
+    repeats collapse onto it via single-flight); ``off`` runs the same
+    traffic with the cache disabled, so "hits" cost the same as misses —
+    the baseline the other two modes are read against.
+    """
+    rng = np.random.default_rng(17)
+
+    def words():
+        return " ".join(rng.choice(BENCH_WORDS, size=24).tolist())
+
+    miss_bodies = [_score_body(f"miss {i}: {words()}") for i in range(requests)]
+    hit_body = _score_body(f"hit: {words()}")
+
+    # one throwaway request to pay connection/handler setup outside both
+    # samples (its fingerprint differs from every timed body)
+    async with session.post(
+        base + "/score/completions", data=_score_body("warmup")
+    ) as resp:
+        assert resp.status == 200, await resp.text()
+        await resp.read()
+
+    # warmup_bursts=0 everywhere: a burst would FILL the cache with the
+    # miss sample's bodies and turn the timed misses into hits
+    _, miss_lat = await _drive(
+        session, base + "/score/completions", miss_bodies, concurrency,
+        warmup_bursts=0,
+    )
+
+    if mode == "warm":
+        async with session.post(
+            base + "/score/completions", data=hit_body
+        ) as resp:
+            assert resp.status == 200
+            await resp.read()
+    total, hit_lat = await _drive(
+        session, base + "/score/completions", [hit_body] * requests,
+        concurrency, warmup_bursts=0,
+    )
+
+    async with session.get(base + "/metrics") as resp:
+        cache_stats = (await resp.json()).get("score_cache")
+
+    emit(
+        f"/score/completions?cache={mode}",
+        len(hit_lat) / total,
+        "requests/sec",
+        cache=mode,
+        requests=requests,
+        concurrency=concurrency,
+        miss_p50_ms=_quantile(miss_lat, 0.50),
+        miss_p95_ms=_quantile(miss_lat, 0.95),
+        hit_p50_ms=_quantile(hit_lat, 0.50),
+        hit_p95_ms=_quantile(hit_lat, 0.95),
+        score_cache=cache_stats,
+        note=(
+            "miss sample = K distinct score bodies (full judge "
+            "round-trip); hit sample = one body x K (replayed from the "
+            "consensus cache when enabled); score_cache = served "
+            "/metrics counters after both samples"
+        ),
+    )
+
+
 async def main_async(args) -> None:
     import aiohttp
 
     runner, fake_runner, port, embedder = await _start_service(
-        args.model, args.window_ms, args.quantize
+        args.model,
+        args.window_ms,
+        args.quantize,
+        cache_ttl_sec=(
+            600.0 if args.cache in ("cold", "warm") else 0.0
+        ),
     )
     base = f"http://127.0.0.1:{port}"
     try:
         async with aiohttp.ClientSession(
             headers={"content-type": "application/json"}
         ) as session:
+            if args.cache is not None:
+                await bench_score_cache(
+                    session, base, args.requests, args.concurrency,
+                    args.cache,
+                )
+                return
             if embedder is not None:
                 await bench_consensus_endpoint(
                     session,
@@ -349,6 +465,15 @@ def main() -> None:
         choices=("none", "int8"),
         default="none",
         help="serve the embedder W8A8 (EMBEDDER_QUANTIZE passthrough)",
+    )
+    parser.add_argument(
+        "--cache",
+        choices=("off", "cold", "warm"),
+        default=None,
+        help="run the consensus-cache scenario instead of the endpoint "
+        "trio: same score request replayed K times, hit vs miss p50/p95 "
+        "(off = cache disabled baseline, cold = first repeat fills the "
+        "entry inside the timed window, warm = entry primed untimed)",
     )
     parser.add_argument("--n", type=int, default=64)
     parser.add_argument("--requests", type=int, default=100)

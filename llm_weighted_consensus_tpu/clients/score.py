@@ -371,6 +371,8 @@ class ScoreClient:
         archive_fetcher: Optional[archive_mod.Fetcher] = None,
         rng_factory=random.Random,
         ballot_sink=None,
+        cache=None,
+        flights=None,
     ) -> None:
         self.chat_client = chat_client
         self.model_fetcher = model_fetcher
@@ -381,6 +383,15 @@ class ScoreClient:
         # the per-judge ballot assignment so stored logprobs can be
         # re-extracted into soft votes later (archive/rescore.py revote)
         self.ballot_sink = ballot_sink
+        # optional content-addressed result cache (cache/ScoreCache) with
+        # single-flight dedup: identical concurrent requests collapse onto
+        # one judge fan-out, repeats replay recorded chunk frames
+        self.cache = cache
+        if flights is None and cache is not None:
+            from ..cache import SingleFlight
+
+            flights = SingleFlight()
+        self.flights = flights
 
     # -- unary (client.rs:71-91) --------------------------------------------
 
@@ -397,9 +408,76 @@ class ScoreClient:
         aggregate = fold_chunks(chunks)
         return ChatCompletion.from_streaming(aggregate)
 
-    # -- streaming (client.rs:93-465) ---------------------------------------
+    # -- cache front door (cache/: fingerprint -> hit replay / miss record) --
+
+    def _cache_key(self, ctx, params) -> Optional[str]:
+        if self.cache is None or not self.cache.enabled:
+            return None
+        if getattr(params, "cache_bypass", None):
+            return None
+        from ..cache import score_fingerprint
+
+        return score_fingerprint(params, ctx)
 
     async def create_streaming(self, ctx, params):
+        """Cache front door.  Uncacheable requests (no cache configured,
+        ``cache_bypass``, unfingerprintable model form) go straight to the
+        live pipeline; otherwise a hit replays the recorded chunk frames
+        byte-identically and a miss claims the single-flight slot — the
+        leader streams live while recording, concurrent identical
+        requests await the leader's recording and replay it."""
+        fp = self._cache_key(ctx, params)
+        if fp is None:
+            return await self._create_streaming_live(ctx, params)
+        from ..cache import replay_stream
+
+        while True:
+            record = self.cache.get(fp)
+            if record is not None:
+                return replay_stream(record)
+            future = self.flights.claim(fp)
+            if future is None:  # leader
+                try:
+                    live = await self._create_streaming_live(ctx, params)
+                except BaseException as e:
+                    self.flights.fail(fp, e)
+                    raise
+                return self._record_and_stream(fp, live)
+            ok, record = await self.flights.wait(future)
+            if ok:
+                return replay_stream(record)
+            # leader abandoned (disconnect) or produced an uncacheable
+            # stream: retry — this caller likely becomes the new leader
+
+    async def _record_and_stream(self, fp, live):
+        """Leader path: stream live to this client while recording; on
+        clean error-free completion the recording lands in the cache and
+        resolves every follower.  Any other outcome (abandoned stream,
+        error items) releases the flight so followers retry as leaders."""
+        import asyncio
+
+        from ..cache import record_stream
+
+        done = False
+
+        def on_complete(chunk_objs):
+            nonlocal done
+            done = True
+            self.cache.put_chunks(fp, chunk_objs)
+            self.flights.complete(fp, chunk_objs)
+
+        rec = record_stream(live, on_complete)
+        try:
+            async for item in rec:
+                yield item
+        finally:
+            await rec.aclose()
+            if not done:
+                self.flights.fail(fp, asyncio.CancelledError())
+
+    # -- streaming (client.rs:93-465) ---------------------------------------
+
+    async def _create_streaming_live(self, ctx, params):
         created = int(time.time())
         resp_id = response_id(RESPONSE_ID_PREFIX, created)
 

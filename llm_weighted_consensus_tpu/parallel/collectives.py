@@ -13,8 +13,9 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .compat import shard_map
 
 
 def sharded_cosine_vote(

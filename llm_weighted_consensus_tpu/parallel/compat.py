@@ -1,0 +1,30 @@
+"""jax API compatibility shims for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace (and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma``) across the jax versions this framework
+supports.  One resolution site here keeps every call site on the new
+spelling while still running on older installed runtimes.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _CHECK_KWARG = "check_vma"
+else:  # pragma: no cover - exercised only on older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KWARG = "check_rep"
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` with the current kwarg spelling, translating
+    ``check_vma`` for runtimes that still call it ``check_rep``."""
+    if "check_vma" in kwargs and _CHECK_KWARG != "check_vma":
+        kwargs[_CHECK_KWARG] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda fn: _shard_map(fn, **kwargs)
+    return _shard_map(f, **kwargs)

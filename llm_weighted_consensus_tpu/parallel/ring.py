@@ -153,7 +153,9 @@ def ring_encode(
             params, ids, mask, config, position_offset=offset
         )
 
-    return jax.shard_map(
+    from .compat import shard_map
+
+    return shard_map(
         local_forward,
         mesh=mesh,
         in_specs=(_replicated_like(params), seq_spec, seq_spec),

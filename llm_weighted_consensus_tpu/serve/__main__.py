@@ -641,13 +641,30 @@ def build_service(
     if embedder is not None and config.warmup:
         _warmup_embedder(embedder, config.warmup, config.warmup_r)
     reranker = build_reranker(config, allow_synthetic=fake_upstream)
+    from .metrics import Metrics
+
+    # metrics exist regardless of the device side: the result cache's
+    # counters (and the HTTP series) are host-only observability
+    metrics = Metrics()
+    score_cache = None
+    embed_cache = None
+    if config.score_cache_ttl_sec > 0:
+        from ..cache import EmbeddingCache, ScoreCache
+
+        score_cache = ScoreCache(
+            config.score_cache_ttl_sec,
+            config.score_cache_max_bytes,
+            config.score_cache_dir,
+        )
+        if config.score_cache_embed:
+            embed_cache = EmbeddingCache(
+                config.score_cache_ttl_sec,
+                config.score_cache_embed_max_bytes,
+            )
     batcher = None
-    metrics = None
     if embedder is not None:
         from .batcher import DeviceBatcher
-        from .metrics import Metrics
 
-        metrics = Metrics()
         batcher = DeviceBatcher(
             embedder,
             metrics,
@@ -655,6 +672,7 @@ def build_service(
             max_batch=config.batch_max,
             pipeline_depth=config.batch_pipeline,
             max_rows=config.batch_max_rows,
+            embed_cache=embed_cache,
         )
     weight_fetchers = WeightFetchers()
     tables = None
@@ -689,6 +707,9 @@ def build_service(
         # ballots stored alongside enable logprob re-extraction in batch
         # re-score (archive/rescore.py revote)
         ballot_sink=store.put_ballot if config.archive_write else None,
+        # SCORE_CACHE_TTL > 0: content-addressed result cache with
+        # single-flight dedup (cache/); None preserves pre-cache behavior
+        cache=score_cache,
     )
     multichat_client = MultichatClient(
         chat_client, model_registry, archive_fetcher=store

@@ -72,9 +72,16 @@ class DeviceBatcher:
         max_batch: int = 64,
         pipeline_depth: int = 2,
         max_rows: int = 512,
+        embed_cache=None,
     ) -> None:
         self.embedder = embedder
         self.metrics = metrics
+        # optional per-row embedding memoization (cache/EmbeddingCache):
+        # hot rows resolve before the dispatch path, and identical rows
+        # in flight collapse onto one device computation
+        self.embed_cache = embed_cache
+        self._embed_inflight: dict = {}
+        self._embed_collapses = 0
         self.window_ms = float(window_ms)
         self.max_batch = int(max_batch)
         self.pipeline_depth = max(1, int(pipeline_depth))
@@ -104,14 +111,108 @@ class DeviceBatcher:
         self._items = 0
         if metrics is not None:
             metrics.register_provider("device_batcher", self.utilization)
+            if embed_cache is not None:
+                metrics.register_provider(
+                    "embed_cache", self._embed_cache_stats
+                )
+
+    def _embed_cache_stats(self) -> dict:
+        stats = self.embed_cache.stats()
+        stats["inflight_collapses"] = self._embed_collapses
+        return stats
 
     # -- public async API ----------------------------------------------------
 
     async def embed(self, texts: list, max_tokens: Optional[int] = None):
         """texts -> (embeddings[N, H] f32, token_count).  Batches with every
-        other embed request sharing the same ``max_tokens`` cap."""
-        return await self._submit(
-            "embed", ("embed", max_tokens), (list(texts), max_tokens)
+        other embed request sharing the same ``max_tokens`` cap.
+
+        With an ``embed_cache`` attached, rows resolve individually
+        BEFORE batching: cached rows skip the device entirely, rows
+        already being computed by a concurrent request are joined rather
+        than recomputed, and only genuinely new rows ride a dispatch.
+        The public contract is unchanged either way."""
+        texts = list(texts)
+        cache = self.embed_cache
+        if cache is None or not cache.enabled or not texts:
+            emb, row_tokens = await self._submit(
+                "embed", ("embed", max_tokens), (texts, max_tokens)
+            )
+            return emb, int(np.asarray(row_tokens).sum())
+        from ..cache.fingerprint import embed_fingerprint
+
+        model_id = getattr(self.embedder, "model_name", "") or ""
+        rows: list = [None] * len(texts)
+        joins: list = []  # (row position, future) — ours or a peer's
+        submit_fps: list = []
+        submit_texts: list = []
+        loop = asyncio.get_running_loop()
+        for i, text in enumerate(texts):
+            fp = embed_fingerprint(model_id, text, max_tokens)
+            hit = cache.get(fp)
+            if hit is not None:
+                rows[i] = hit
+                continue
+            fut = self._embed_inflight.get(fp)
+            if fut is not None:
+                # identical row already being computed (by a concurrent
+                # request, or earlier in THIS text list): join it
+                self._embed_collapses += 1
+                joins.append((i, fut))
+                continue
+            fut = loop.create_future()
+            self._embed_inflight[fp] = fut
+            submit_fps.append(fp)
+            submit_texts.append(text)
+            joins.append((i, fut))
+        if submit_texts:
+            try:
+                emb, row_tokens = await self._submit(
+                    "embed", ("embed", max_tokens), (submit_texts, max_tokens)
+                )
+            except BaseException as e:
+                for fp in submit_fps:
+                    fut = self._embed_inflight.pop(fp, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(e)
+                        fut.exception()  # joined peers re-raise; lone
+                        # futures must not warn "never retrieved"
+                raise
+            row_tokens = np.asarray(row_tokens)
+            for j, fp in enumerate(submit_fps):
+                vec = np.asarray(emb[j])
+                cache.put_row(fp, vec, int(row_tokens[j]))
+                fut = self._embed_inflight.pop(fp, None)
+                if fut is not None and not fut.done():
+                    fut.set_result((vec, int(row_tokens[j])))
+        retry: list = []
+        for i, fut in joins:
+            try:
+                # shielded: this caller's cancellation must not poison a
+                # future other requests are also joined on
+                rows[i] = await asyncio.shield(fut)
+            except BaseException:
+                if (
+                    fut.done()
+                    and not fut.cancelled()
+                    and fut.exception() is not None
+                ):
+                    retry.append(i)  # the peer's dispatch failed —
+                    # recompute rather than inherit its fate
+                else:
+                    raise  # this caller itself was cancelled
+        if retry:
+            emb, row_tokens = await self._submit(
+                "embed",
+                ("embed", max_tokens),
+                ([texts[i] for i in retry], max_tokens),
+            )
+            row_tokens = np.asarray(row_tokens)
+            for j, i in enumerate(retry):
+                rows[i] = (np.asarray(emb[j]), int(row_tokens[j]))
+        return (
+            np.stack([r[0] for r in rows]).astype(np.float32, copy=False),
+            int(sum(r[1] for r in rows)),
         )
 
     async def consensus(self, texts: list, temperature: float = 0.05):
@@ -357,10 +458,13 @@ class DeviceBatcher:
         out = []
         start = 0
         for count in counts:
+            # per-ROW token counts (not the summed total): embed() needs
+            # row granularity for the per-row memoization path and sums
+            # for the public (emb, total_tokens) contract
             out.append(
                 (
                     emb[start : start + count],
-                    int(tokens[start : start + count].sum()),
+                    tokens[start : start + count],
                 )
             )
             start += count

@@ -95,6 +95,30 @@ TPU additions:
 * ``BATCH_MAX_ROWS`` — encoder rows per fused dispatch; a synchronized
   burst of requests chunks into this many rows per dispatch so the
   pipeline has pieces to overlap.  Default 512.
+* ``SCORE_CACHE_TTL`` — seconds a cached consensus result stays
+  servable.  ``0`` (the default) disables the result cache entirely:
+  the service behaves exactly as before the cache existed.  When >0,
+  score requests are fingerprinted (cache/fingerprint.py: panel id +
+  canonicalized messages + choices + sampling params, JSON field order
+  irrelevant) and identical requests within the TTL replay the recorded
+  chunk stream instead of re-running the judge fan-out; identical
+  *concurrent* requests collapse onto one in-flight fan-out
+  (single-flight).  Per-request opt-out: ``"cache_bypass": true``.
+* ``SCORE_CACHE_MAX_BYTES`` — byte budget for the in-memory score result
+  LRU.  Default 67108864 (64 MiB).
+* ``SCORE_CACHE_DIR`` — append-only JSONL disk tier for the score cache
+  (the COMPILE_CACHE_DIR pattern applied to results): entries persist
+  across restarts and reload at startup, expired ones skipped.  Unset =
+  memory only.
+* ``SCORE_CACHE_EMBED`` — also memoize embedding rows per
+  (model, truncation window, text) in the micro-batcher, so hot rows
+  skip device dispatch.  Defaults on whenever ``SCORE_CACHE_TTL`` > 0;
+  ``SCORE_CACHE_EMBED=0`` disables.
+* ``SCORE_CACHE_EMBED_MAX_BYTES`` — byte budget for the embedding row
+  cache.  Default 33554432 (32 MiB).
+
+Cache counters (hits/misses/evictions/in-flight collapses) surface as
+the ``score_cache`` / ``embed_cache`` sections of ``GET /metrics``.
 """
 
 from __future__ import annotations
@@ -271,6 +295,16 @@ class Config:
     # (consensus_confidence_tokens_many) path for, per WARMUP shape
     # (WARMUP_R env, e.g. "2,4"); [] = single-request path only
     warmup_r: list = field(default_factory=list)
+    # consensus result cache (cache/): TTL seconds, 0 = disabled (exact
+    # pre-cache behavior); byte budget for the in-memory LRU; optional
+    # JSONL disk tier for warm restarts
+    score_cache_ttl_sec: float = 0.0
+    score_cache_max_bytes: int = 64 * 1024 * 1024
+    score_cache_dir: Optional[str] = None
+    # per-row embedding memoization in the micro-batcher; defaults on
+    # whenever the score cache is on
+    score_cache_embed: bool = False
+    score_cache_embed_max_bytes: int = 32 * 1024 * 1024
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -348,6 +382,20 @@ class Config:
             batch_max_rows=max(1, int(env.get("BATCH_MAX_ROWS", 512))),
             warmup=_parse_warmup(env.get("WARMUP")),
             warmup_r=_parse_warmup_r(env.get("WARMUP_R")),
+            score_cache_ttl_sec=max(0.0, get_f("SCORE_CACHE_TTL", 0)),
+            score_cache_max_bytes=_non_negative_int(
+                env, "SCORE_CACHE_MAX_BYTES", 64 * 1024 * 1024
+            ),
+            score_cache_dir=env.get("SCORE_CACHE_DIR"),
+            score_cache_embed=env_truthy(
+                env.get(
+                    "SCORE_CACHE_EMBED",
+                    "1" if float(env.get("SCORE_CACHE_TTL", 0) or 0) > 0 else "0",
+                )
+            ),
+            score_cache_embed_max_bytes=_non_negative_int(
+                env, "SCORE_CACHE_EMBED_MAX_BYTES", 32 * 1024 * 1024
+            ),
         )
         if config.warmup_r and not config.warmup:
             # same loud-failure contract as _parse_warmup: WARMUP_R names

@@ -280,6 +280,7 @@ def build_app(
     batch_window_ms: float = 3.0,
     batch_max: int = 64,
     reranker=None,
+    embed_cache=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
     if embedder is not None and batcher is None:
@@ -290,7 +291,24 @@ def build_app(
             metrics,
             window_ms=batch_window_ms,
             max_batch=batch_max,
+            embed_cache=embed_cache,
         )
+    # consensus result cache counters (hits/misses/evictions + in-flight
+    # collapses) surface as the `score_cache` section of GET /metrics;
+    # the score client may arrive wrapped (_ArchivingClient delegates)
+    inner_score = getattr(score_client, "_inner", score_client)
+    score_cache = getattr(inner_score, "cache", None)
+    if score_cache is not None:
+        score_flights = getattr(inner_score, "flights", None)
+
+        def _score_cache_stats():
+            stats = score_cache.stats()
+            stats["inflight_collapses"] = (
+                score_flights.collapses if score_flights is not None else 0
+            )
+            return stats
+
+        metrics.register_provider("score_cache", _score_cache_stats)
     app = web.Application(middlewares=[middleware(metrics)])
     app[METRICS_KEY] = metrics
     if batcher is not None:

@@ -74,6 +74,9 @@ class ChatCompletionCreateParams(Struct):
     usage: Optional[UsageInclude] = field(UsageInclude, default=None)
     # custom fields
     choices: list = field(List(CHOICE), default_factory=list, skip_if_none=False)
+    # opt out of the consensus result cache for this request (cache/);
+    # non-semantic: never part of the request fingerprint
+    cache_bypass: Optional[bool] = field(bool, default=None)
 
     def template_content(self) -> str:
         return "\n".join(m.template_content() for m in self.messages)

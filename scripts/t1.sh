@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the ROADMAP.md command, verbatim, so every session and CI
+# hook runs the IDENTICAL gate (same markers, same plugins disabled, same
+# timeout, same DOTS_PASSED accounting).  Run from the repo root.
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,128 @@
+"""Regression tests for InMemoryArchive capping (_evict_over_cap / enforce_cap).
+
+The cap logic only reads ``completion.id``, so a SimpleNamespace stands in
+for real completion objects — these tests pin eviction ORDER and table
+consistency, not serialization (test_multichat covers real completions).
+"""
+
+from types import SimpleNamespace
+
+from llm_weighted_consensus_tpu.archive import InMemoryArchive
+
+
+def comp(cid: str) -> SimpleNamespace:
+    return SimpleNamespace(id=cid)
+
+
+def test_fifo_eviction_order():
+    # oldest insertion evicted first; dict insertion order is the queue
+    store = InMemoryArchive(max_completions=3)
+    for cid in ("a", "b", "c", "d", "e"):
+        store.put_chat(comp(cid))
+    assert store.chat_ids() == ["c", "d", "e"]
+
+
+def test_reinserting_existing_id_does_not_evict():
+    # overwriting a live id keeps len(table) constant: no eviction fires
+    store = InMemoryArchive(max_completions=2)
+    store.put_score(comp("a"))
+    store.put_score(comp("b"))
+    store.put_score(comp("a"))
+    assert store.score_ids() == ["a", "b"]
+
+
+def test_cap_zero_holds_nothing():
+    store = InMemoryArchive(max_completions=0)
+    store.put_chat(comp("a"))
+    store.put_score(comp("b"))
+    store.put_multichat(comp("c"))
+    assert store.chat_ids() == []
+    assert store.score_ids() == []
+    assert store.multichat_ids() == []
+
+
+def test_negative_cap_treated_as_zero():
+    # max(0, cap): a negative cap must drain to empty, not loop forever
+    store = InMemoryArchive(max_completions=-5)
+    store.put_chat(comp("a"))
+    assert store.chat_ids() == []
+
+
+def test_unbounded_when_cap_is_none():
+    store = InMemoryArchive()
+    for i in range(100):
+        store.put_chat(comp(f"c{i}"))
+    assert len(store.chat_ids()) == 100
+
+
+def test_score_eviction_drops_ballots_and_request():
+    # evicting a score completion must drop its ballot record and its
+    # originating request too — both are useless without the completion
+    store = InMemoryArchive(max_completions=1)
+    store.put_score(comp("old"))
+    store.put_ballot("old", 0, [("k", 1)])
+    store.put_score_request("old", SimpleNamespace(messages=[]))
+
+    store.put_score(comp("new"))
+
+    assert store.score_ids() == ["new"]
+    assert store.score_ballots("old") is None
+    assert store.score_request("old") is None
+
+
+def test_chat_eviction_leaves_score_tables_alone():
+    # the ballots/requests cascade is score-table-only; a chat table at
+    # cap must not touch score side tables even with colliding ids
+    store = InMemoryArchive(max_completions=1)
+    store.put_score(comp("x"))
+    store.put_ballot("x", 0, [("k", 0)])
+    store.put_chat(comp("x"))
+    store.put_chat(comp("y"))  # evicts chat "x"
+    assert store.chat_ids() == ["y"]
+    assert store.score_ids() == ["x"]
+    assert store.score_ballots("x") == {0: [("k", 0)]}
+
+
+def test_enforce_cap_applies_to_all_tables():
+    # lowering the cap after the fact (e.g. loading an over-cap snapshot)
+    # trims every table, oldest first, and cascades score side tables
+    store = InMemoryArchive()
+    for cid in ("c1", "c2", "c3"):
+        store.put_chat(comp(cid))
+    for cid in ("s1", "s2", "s3"):
+        store.put_score(comp(cid))
+        store.put_ballot(cid, 0, [("k", 2)])
+    for cid in ("m1", "m2", "m3"):
+        store.put_multichat(comp(cid))
+
+    store.max_completions = 1
+    store.enforce_cap()
+
+    assert store.chat_ids() == ["c3"]
+    assert store.score_ids() == ["s3"]
+    assert store.multichat_ids() == ["m3"]
+    assert store.score_ballots("s1") is None
+    assert store.score_ballots("s2") is None
+    assert store.score_ballots("s3") == {0: [("k", 2)]}
+
+
+def test_enforce_cap_noop_when_unbounded():
+    store = InMemoryArchive()
+    store.put_chat(comp("a"))
+    store.enforce_cap()
+    assert store.chat_ids() == ["a"]
+
+
+def test_eviction_keeps_ballot_tables_consistent_under_churn():
+    # sustained over-cap traffic: side tables must track the score table
+    # exactly (no leaked ballots/requests for evicted completions)
+    store = InMemoryArchive(max_completions=4)
+    for i in range(32):
+        cid = f"s{i}"
+        store.put_score(comp(cid))
+        store.put_ballot(cid, 0, [("k", i)])
+        store.put_score_request(cid, SimpleNamespace(messages=[]))
+    live = store.score_ids()
+    assert live == ["s28", "s29", "s30", "s31"]
+    assert sorted(store._ballots) == sorted(live)
+    assert sorted(store._score_requests) == sorted(live)

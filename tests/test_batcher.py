@@ -497,3 +497,100 @@ def test_gateway_streaming_consensus_through_batcher(embedder):
             assert abs(sum(float(v) for v in final.values()) - 1.0) < 1e-5
 
     go(with_client(app, run))
+
+
+# -- per-row embedding memoization (cache/EmbeddingCache) ---------------------
+
+
+def test_embed_cache_memoizes_rows(embedder):
+    from llm_weighted_consensus_tpu.cache import EmbeddingCache
+
+    metrics = Metrics()
+    batcher = DeviceBatcher(
+        embedder,
+        metrics,
+        window_ms=5.0,
+        embed_cache=EmbeddingCache(60, 1 << 20),
+    )
+
+    async def run():
+        a = await batcher.embed(["alpha text", "beta text"])
+        b = await batcher.embed(["alpha text", "beta text"])
+        return a, b
+
+    (emb_a, tok_a), (emb_b, tok_b) = go(run())
+    # second call is pure cache: identical rows, zero extra dispatches
+    np.testing.assert_array_equal(np.asarray(emb_a), np.asarray(emb_b))
+    assert tok_a == tok_b == embedder.token_count(["alpha text", "beta text"])
+    assert metrics.snapshot()["series"]["device:batch:embed"]["count"] == 1
+    stats = metrics.snapshot()["embed_cache"]
+    assert stats["hits"] == 2 and stats["entries"] == 2
+    # and matches the uncached reference numerically
+    ref = embedder.embed_texts(["alpha text", "beta text"])
+    np.testing.assert_allclose(np.asarray(emb_a), ref, atol=1e-5)
+
+
+def test_embed_cache_partial_hit_assembles_correctly(embedder):
+    from llm_weighted_consensus_tpu.cache import EmbeddingCache
+
+    batcher = DeviceBatcher(
+        embedder, window_ms=5.0, embed_cache=EmbeddingCache(60, 1 << 20)
+    )
+
+    async def run():
+        await batcher.embed(["x row", "y row"])
+        return await batcher.embed(["y row", "z row"])  # hit + miss mix
+
+    emb, tokens = go(run())
+    ref = embedder.embed_texts(["y row", "z row"])
+    np.testing.assert_allclose(np.asarray(emb), ref, atol=1e-5)
+    assert tokens == embedder.token_count(["y row", "z row"])
+
+
+def test_embed_cache_collapses_inflight_duplicates(embedder):
+    from llm_weighted_consensus_tpu.cache import EmbeddingCache
+
+    metrics = Metrics()
+    batcher = DeviceBatcher(
+        embedder,
+        metrics,
+        window_ms=20.0,
+        embed_cache=EmbeddingCache(60, 1 << 20),
+    )
+
+    async def run():
+        return await asyncio.gather(
+            *(batcher.embed(["same row"]) for _ in range(4))
+        )
+
+    results = go(run())
+    for emb, tokens in results:
+        np.testing.assert_array_equal(
+            np.asarray(emb), np.asarray(results[0][0])
+        )
+        assert tokens == results[0][1]
+    # 4 concurrent identical rows -> ONE device row computed
+    util = metrics.snapshot()["device_batcher"]
+    assert util["items"] == 1
+    assert metrics.snapshot()["embed_cache"]["inflight_collapses"] == 3
+
+
+def test_embed_cache_duplicate_rows_in_one_request(embedder):
+    from llm_weighted_consensus_tpu.cache import EmbeddingCache
+
+    metrics = Metrics()
+    batcher = DeviceBatcher(
+        embedder,
+        metrics,
+        window_ms=5.0,
+        embed_cache=EmbeddingCache(60, 1 << 20),
+    )
+
+    async def run():
+        return await batcher.embed(["dup row", "dup row"])
+
+    emb, tokens = go(run())
+    np.testing.assert_array_equal(np.asarray(emb[0]), np.asarray(emb[1]))
+    # token accounting still counts BOTH rows (public contract unchanged)
+    assert tokens == embedder.token_count(["dup row", "dup row"])
+    assert metrics.snapshot()["device_batcher"]["items"] == 1

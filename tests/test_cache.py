@@ -1,0 +1,591 @@
+"""Content-addressed consensus cache (cache/): fingerprints, the
+two-tier store, single-flight collapse, streamed replay, and the
+end-to-end gateway behavior (hit == miss on the wire, `/metrics`
+counters, cache_bypass / TTL=0 preserving cacheless behavior)."""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from llm_weighted_consensus_tpu import archive, registry
+from llm_weighted_consensus_tpu.ballot import PrefixTree
+from llm_weighted_consensus_tpu.cache import (
+    CacheStore,
+    ScoreCache,
+    SingleFlight,
+    embed_fingerprint,
+    record_stream,
+    replay_stream,
+    score_fingerprint,
+)
+from llm_weighted_consensus_tpu.clients.chat import (
+    ApiBase,
+    BackoffPolicy,
+    DefaultChatClient,
+)
+from llm_weighted_consensus_tpu.clients.score import ScoreClient
+from llm_weighted_consensus_tpu.identity import ID_LEN
+from llm_weighted_consensus_tpu.serve import build_app
+from llm_weighted_consensus_tpu.types.score_request import (
+    ChatCompletionCreateParams as ScoreParams,
+)
+from llm_weighted_consensus_tpu.types.score_response import ChatCompletionChunk
+from llm_weighted_consensus_tpu.utils import jsonutil
+
+from fakes import FakeTransport, Script, chunk_obj
+
+SEED = 11
+NO_RETRY = BackoffPolicy(max_elapsed_ms=0)
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def ballot_keys(n):
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, n, 20)
+    return {idx: k for k, idx in tree.key_indices(rng)}
+
+
+JUDGES = {"llms": [{"model": "j1"}]}
+
+
+def score_body(**overrides):
+    body = {
+        "messages": [{"role": "user", "content": "q"}],
+        "model": JUDGES,
+        "choices": ["first", "second"],
+    }
+    body.update(overrides)
+    return body
+
+
+def make_score_client(scripts, cache):
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    return (
+        ScoreClient(
+            chat,
+            registry.InMemoryModelRegistry(),
+            archive_fetcher=archive.InMemoryArchive(),
+            rng_factory=lambda: random.Random(SEED),
+            cache=cache,
+        ),
+        transport,
+    )
+
+
+def winning_script():
+    keys = ballot_keys(2)
+    return Script([chunk_obj(f"pick {keys[1]}", finish="stop")])
+
+
+# -- fingerprints -------------------------------------------------------------
+
+
+def test_score_fingerprint_ignores_json_field_order():
+    a = ScoreParams.from_json_obj(json.loads(jsonutil.dumps(score_body())))
+    shuffled = {
+        "choices": ["first", "second"],
+        "model": JUDGES,
+        "messages": [{"role": "user", "content": "q"}],
+    }
+    b = ScoreParams.from_json_obj(shuffled)
+    fa, fb = score_fingerprint(a), score_fingerprint(b)
+    assert fa is not None and len(fa) == ID_LEN
+    assert fa == fb
+
+
+def test_score_fingerprint_ignores_non_semantic_fields():
+    base = ScoreParams.from_json_obj(score_body())
+    streamed = ScoreParams.from_json_obj(score_body(stream=True))
+    bypass = ScoreParams.from_json_obj(score_body(cache_bypass=True))
+    assert score_fingerprint(base) == score_fingerprint(streamed)
+    assert score_fingerprint(base) == score_fingerprint(bypass)
+
+
+def test_score_fingerprint_sensitive_to_semantics_and_ctx():
+    base = ScoreParams.from_json_obj(score_body())
+    other_msg = ScoreParams.from_json_obj(
+        score_body(messages=[{"role": "user", "content": "different"}])
+    )
+    other_choices = ScoreParams.from_json_obj(
+        score_body(choices=["first", "other"])
+    )
+    seeded = ScoreParams.from_json_obj(score_body(seed=7))
+    assert score_fingerprint(base) != score_fingerprint(other_msg)
+    assert score_fingerprint(base) != score_fingerprint(other_choices)
+    assert score_fingerprint(base) != score_fingerprint(seeded)
+    # results computed under one credential never serve another
+    assert score_fingerprint(base, "Bearer a") != score_fingerprint(
+        base, "Bearer b"
+    )
+
+
+def test_score_fingerprint_panel_member_order_canonical():
+    # the panel id canonicalizes member declaration order (identity
+    # layer sorts judges by content-addressed id), so the fingerprint
+    # must too
+    two = {"llms": [{"model": "j1"}, {"model": "j2"}]}
+    two_rev = {"llms": [{"model": "j2"}, {"model": "j1"}]}
+    a = ScoreParams.from_json_obj(score_body(model=two))
+    b = ScoreParams.from_json_obj(score_body(model=two_rev))
+    assert score_fingerprint(a) == score_fingerprint(b)
+
+
+def test_embed_fingerprint_row_keys():
+    a = embed_fingerprint("bge-small-en", "hello", 128)
+    assert len(a) == ID_LEN
+    assert a == embed_fingerprint("bge-small-en", "hello", 128)
+    assert a != embed_fingerprint("bge-small-en", "hello", 64)
+    assert a != embed_fingerprint("bge-small-en", "hello!", 128)
+    assert a != embed_fingerprint("e5-base-v2", "hello", 128)
+
+
+# -- store: TTL, LRU byte budget, disk tier -----------------------------------
+
+
+def test_ttl_expiry_with_injectable_clock():
+    now = [1000.0]
+    store = CacheStore(ttl_sec=10, max_bytes=1 << 20, clock=lambda: now[0])
+    store.put("k1", "v1", 10)
+    assert store.get("k1") == "v1"
+    now[0] += 9.99
+    assert store.get("k1") == "v1"
+    now[0] += 0.02
+    assert store.get("k1") is None
+    stats = store.stats()
+    assert stats["expirations"] == 1 and stats["entries"] == 0
+
+
+def test_lru_eviction_under_byte_budget():
+    store = CacheStore(ttl_sec=60, max_bytes=100)
+    store.put("a", "A", 40)
+    store.put("b", "B", 40)
+    assert store.get("a") == "A"  # refresh: a is now most-recent
+    store.put("c", "C", 40)  # budget forces one eviction: b, not a
+    assert store.get("b") is None
+    assert store.get("a") == "A" and store.get("c") == "C"
+    assert store.stats()["evictions"] == 1
+    # an entry larger than the whole budget is refused, not destructive
+    store.put("huge", "X", 101)
+    assert store.get("huge") is None
+    assert store.get("a") == "A"
+
+
+def test_store_disabled_at_ttl_zero():
+    store = CacheStore(ttl_sec=0, max_bytes=1 << 20)
+    assert not store.enabled
+    store.put("k", "v", 1)
+    assert store.get("k") is None
+    assert store.stats()["misses"] == 0  # disabled get touches no state
+
+
+def test_disk_tier_warm_restart(tmp_path):
+    d = str(tmp_path / "seg")
+    first = ScoreCache(60, 1 << 20, d)
+    chunks = [{"id": "x", "choices": [], "created": 1, "model": "m"}]
+    first.put_chunks("f" * ID_LEN, chunks)
+    # a fresh instance over the same dir serves the entry from disk
+    second = ScoreCache(60, 1 << 20, d)
+    assert second.disk_loaded == 1
+    assert second.get("f" * ID_LEN) == chunks
+
+
+def test_disk_tier_skips_expired_on_load(tmp_path):
+    d = str(tmp_path / "seg")
+    now = [1000.0]
+    first = ScoreCache(10, 1 << 20, d, clock=lambda: now[0])
+    first.put_chunks("f" * ID_LEN, [{"id": "x"}])
+    now[0] += 11
+    second = ScoreCache(10, 1 << 20, d, clock=lambda: now[0])
+    assert second.disk_loaded == 0
+    assert len(second) == 0
+
+
+def test_disk_tier_survives_torn_tail_write(tmp_path):
+    d = tmp_path / "seg"
+    first = ScoreCache(60, 1 << 20, str(d))
+    first.put_chunks("f" * ID_LEN, [{"id": "x"}])
+    seg = next(d.glob("seg-*.jsonl"))
+    with open(seg, "a", encoding="utf-8") as f:
+        f.write('{"k": "truncated mid-wri')  # crash mid-append
+    second = ScoreCache(60, 1 << 20, str(d))
+    assert second.disk_loaded == 1
+
+
+# -- single-flight ------------------------------------------------------------
+
+
+def test_singleflight_do_collapses_concurrent_callers():
+    sf = SingleFlight()
+    calls = []
+
+    async def factory():
+        calls.append(1)
+        await asyncio.sleep(0.01)
+        return "result"
+
+    async def run():
+        return await asyncio.gather(*(sf.do("k", factory) for _ in range(8)))
+
+    results = go(run())
+    assert results == ["result"] * 8
+    assert len(calls) == 1
+    assert sf.collapses == 7
+    assert len(sf) == 0  # table cleaned up
+
+
+def test_singleflight_failure_propagates_and_cleans_up():
+    sf = SingleFlight()
+
+    async def factory():
+        raise RuntimeError("boom")
+
+    async def one():
+        with pytest.raises(RuntimeError):
+            await sf.do("k", factory)
+
+    go(one())
+    assert len(sf) == 0
+
+
+def test_singleflight_cancelled_leader_promotes_follower():
+    sf = SingleFlight()
+    calls = []
+
+    async def run():
+        started = asyncio.Event()
+
+        async def slow_leader():
+            calls.append("leader")
+            started.set()
+            await asyncio.sleep(30)
+            return "never"
+
+        async def follower_factory():
+            calls.append("follower")
+            return "rescued"
+
+        leader_task = asyncio.create_task(sf.do("k", slow_leader))
+        await started.wait()
+        follower_task = asyncio.create_task(sf.do("k", follower_factory))
+        await asyncio.sleep(0)  # follower parks on the leader's future
+        leader_task.cancel()
+        return await follower_task
+
+    assert go(run()) == "rescued"
+    assert calls == ["leader", "follower"]
+
+
+# -- record / replay ----------------------------------------------------------
+
+
+def make_chunk(content="c", finish=None, error=None):
+    choice = {"index": 0, "delta": {"content": content}, "finish_reason": finish}
+    if error is not None:
+        choice["error"] = error
+    return ChatCompletionChunk.from_json_obj(
+        {"id": "r", "choices": [choice], "created": 1, "model": "m"}
+    )
+
+
+def test_record_fires_only_on_clean_completion():
+    recorded = []
+
+    async def live():
+        yield make_chunk("a")
+        yield make_chunk("b", finish="stop")
+
+    async def run():
+        out = []
+        async for item in record_stream(live(), recorded.append):
+            out.append(item)
+        return out
+
+    out = go(run())
+    assert len(out) == 2
+    assert len(recorded) == 1
+    assert [o["choices"][0]["delta"]["content"] for o in recorded[0]] == [
+        "a",
+        "b",
+    ]
+
+
+def test_record_skips_abandoned_stream():
+    recorded = []
+
+    async def live():
+        yield make_chunk("a")
+        yield make_chunk("b")
+
+    async def run():
+        rec = record_stream(live(), recorded.append)
+        async for _ in rec:
+            break  # consumer walks away mid-stream
+        await rec.aclose()
+
+    go(run())
+    assert recorded == []
+
+
+def test_record_skips_error_streams():
+    recorded = []
+
+    async def with_error_item():
+        yield make_chunk("a")
+        yield RuntimeError("trailing error item")
+
+    async def with_error_choice():
+        yield make_chunk("a")
+        yield make_chunk("b", error={"code": 500, "message": "judge died"})
+
+    async def drain(stream):
+        async for _ in record_stream(stream, recorded.append):
+            pass
+
+    go(drain(with_error_item()))
+    go(drain(with_error_choice()))
+    assert recorded == []
+
+
+def test_replay_decodes_fresh_chunks_per_call():
+    record = [make_chunk("a").to_json_obj()]
+
+    async def collect():
+        return [item async for item in replay_stream(record)]
+
+    first, second = go(collect()), go(collect())
+    assert first[0].to_json_obj() == second[0].to_json_obj()
+    assert first[0] is not second[0]  # no shared mutable state across hits
+
+
+# -- the score client end-to-end ----------------------------------------------
+
+
+def consume_frames(score, params, ctx=None):
+    """Fully consume one streaming score request -> serialized frames."""
+
+    async def run():
+        stream = await score.create_streaming(ctx, params)
+        frames = []
+        try:
+            async for item in stream:
+                if isinstance(item, Exception):
+                    frames.append(f"error:{item}")
+                else:
+                    frames.append(jsonutil.dumps(item.to_json_obj()))
+        finally:
+            await stream.aclose()
+        return frames
+
+    return run
+
+
+def test_identical_concurrent_requests_collapse_to_one_upstream_call():
+    score, transport = make_score_client(
+        [winning_script()], ScoreCache(60, 1 << 20)
+    )
+    params = ScoreParams.from_json_obj(score_body())
+
+    async def run():
+        return await asyncio.gather(
+            *(consume_frames(score, params)() for _ in range(8))
+        )
+
+    results = go(run())
+    # ONE judge fan-out for 8 concurrent identical requests (a second
+    # would exhaust the script list and raise "unexpected request")
+    assert len(transport.requests) == 1
+    assert all(r == results[0] for r in results)
+    assert score.flights.collapses == 7
+    assert score.cache.stats()["entries"] == 1
+
+
+def test_streamed_hit_replays_byte_identical_frames():
+    score, transport = make_score_client(
+        [winning_script()], ScoreCache(60, 1 << 20)
+    )
+    params = ScoreParams.from_json_obj(score_body())
+    miss = go(consume_frames(score, params)())
+    hit = go(consume_frames(score, params)())
+    assert len(transport.requests) == 1
+    assert hit == miss  # frame-for-frame, byte-for-byte
+    stats = score.cache.stats()
+    assert stats["hits"] >= 1 and stats["misses"] >= 1
+
+
+def test_unary_hit_equals_miss_result():
+    score, transport = make_score_client(
+        [winning_script()], ScoreCache(60, 1 << 20)
+    )
+    params = ScoreParams.from_json_obj(score_body())
+
+    async def run():
+        a = await score.create_unary(None, params)
+        b = await score.create_unary(None, params)
+        return a, b
+
+    a, b = go(run())
+    assert len(transport.requests) == 1
+    assert a.to_json() == b.to_json()
+    assert a.choices[1].confidence == 1
+
+
+def test_cache_bypass_flag_goes_live_every_time():
+    score, transport = make_score_client(
+        [winning_script(), winning_script()], ScoreCache(60, 1 << 20)
+    )
+    params = ScoreParams.from_json_obj(score_body(cache_bypass=True))
+    go(consume_frames(score, params)())
+    go(consume_frames(score, params)())
+    assert len(transport.requests) == 2
+    assert score.cache.stats()["entries"] == 0
+
+
+def test_ttl_zero_preserves_cacheless_behavior():
+    score, transport = make_score_client(
+        [winning_script(), winning_script()], ScoreCache(0, 1 << 20)
+    )
+    params = ScoreParams.from_json_obj(score_body())
+    first = go(consume_frames(score, params)())
+    second = go(consume_frames(score, params)())
+    assert len(transport.requests) == 2
+    # two live runs differ only in stamped id/created, never in shape
+    assert len(first) == len(second)
+
+
+def test_expired_entry_recomputes():
+    now = [1000.0]
+    score, transport = make_score_client(
+        [winning_script(), winning_script()],
+        ScoreCache(10, 1 << 20, clock=lambda: now[0]),
+    )
+    params = ScoreParams.from_json_obj(score_body())
+    go(consume_frames(score, params)())
+    now[0] += 11
+    go(consume_frames(score, params)())
+    assert len(transport.requests) == 2
+    assert score.cache.stats()["expirations"] == 1
+
+
+def test_error_responses_are_not_cached():
+    # both judges' upstreams fail -> AllVotesFailed trailing item; the
+    # next identical request must go upstream again
+    score, transport = make_score_client(
+        [Script(status=503, body=b"{}"), winning_script()],
+        ScoreCache(60, 1 << 20),
+    )
+    params = ScoreParams.from_json_obj(score_body())
+    first = go(consume_frames(score, params)())
+    assert any(f.startswith("error:") for f in first)
+    second = go(consume_frames(score, params)())
+    assert len(transport.requests) == 2
+    assert not any(f.startswith("error:") for f in second)
+
+
+def test_disk_warm_restart_end_to_end(tmp_path):
+    d = str(tmp_path / "cache")
+    score, transport = make_score_client(
+        [winning_script()], ScoreCache(60, 1 << 20, d)
+    )
+    params = ScoreParams.from_json_obj(score_body())
+    miss = go(consume_frames(score, params)())
+    # a brand-new client (fresh process analog) with NO scripts: only the
+    # disk tier can serve this
+    score2, transport2 = make_score_client([], ScoreCache(60, 1 << 20, d))
+    hit = go(consume_frames(score2, params)())
+    assert transport2.requests == []
+    assert hit == miss
+
+
+# -- gateway end-to-end -------------------------------------------------------
+
+
+def make_app(scripts, cache):
+    from llm_weighted_consensus_tpu.clients.multichat import MultichatClient
+
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    reg = registry.InMemoryModelRegistry()
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat,
+        reg,
+        archive_fetcher=store,
+        rng_factory=lambda: random.Random(SEED),
+        cache=cache,
+    )
+    multichat = MultichatClient(chat, reg, archive_fetcher=store)
+    return build_app(chat, score, multichat), transport
+
+
+async def with_client(app, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def post_json(client, path, obj):
+    return client.post(
+        path,
+        data=jsonutil.dumps(obj),
+        headers={"content-type": "application/json"},
+    )
+
+
+def test_gateway_streamed_hit_is_wire_identical_and_counted():
+    app, transport = make_app([winning_script()], ScoreCache(60, 1 << 20))
+
+    async def run(client):
+        async def stream_once():
+            resp = await post_json(
+                client, "/score/completions", score_body(stream=True)
+            )
+            assert resp.status == 200
+            return await resp.read()
+
+        miss = await stream_once()
+        hit = await stream_once()
+        assert hit == miss  # raw SSE bytes, frames + [DONE]
+        metrics = await (await client.get("/metrics")).json()
+        cache_section = metrics["score_cache"]
+        assert cache_section["hits"] >= 1
+        assert cache_section["misses"] >= 1
+        assert cache_section["entries"] == 1
+
+    go(with_client(app, run))
+    assert len(transport.requests) == 1
+
+
+def test_gateway_authorization_partitions_the_cache():
+    app, transport = make_app(
+        [winning_script(), winning_script()], ScoreCache(60, 1 << 20)
+    )
+
+    async def run(client):
+        for auth in ("Bearer alice", "Bearer bob"):
+            resp = await client.post(
+                "/score/completions",
+                data=jsonutil.dumps(score_body()),
+                headers={
+                    "content-type": "application/json",
+                    "authorization": auth,
+                },
+            )
+            assert resp.status == 200
+
+    go(with_client(app, run))
+    assert len(transport.requests) == 2  # no cross-credential hits

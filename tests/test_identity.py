@@ -152,6 +152,41 @@ def test_golden_ids_current():
     assert len(base.id_string()) == 22
 
 
+GOLDEN_PANEL_IDS = {
+    # Panel-level hash-stability goldens: (id, multichat_id, training_table_id).
+    # These are the strings the completions archive, the consensus cache
+    # (cache/fingerprint.py canonicalizes a model param to its panel id), and
+    # training-table snapshots key on — drift here silently orphans all three.
+    # Never change them without a key-space version bump.
+    '{"llms":[{"model":"openai/gpt-4o"}]}': (
+        "2MYVV2IGiD5yA8EXq9zFOb", "6pSL3dcSXOgBgnXtm1n5zU", None),
+    '{"llms":[{"model":"openai/gpt-4o"},'
+    '{"model":"anthropic/claude-3.5-sonnet",'
+    '"weight":{"type":"static","weight":2.0}},'
+    '{"model":"google/gemini-1.5-pro"}]}': (
+        "5P1jfD3R0tcdGqwYa5uYey", "5bdfHhHSbyqUGKasua7rL4", None),
+    '{"weight":{"type":"training_table","embeddings":{"model":"bge-small-en",'
+    '"max_tokens":512},"top":10},'
+    '"llms":[{"model":"a","weight":{"type":"training_table","base_weight":1.0,'
+    '"min_weight":0.5,"max_weight":2.0}},'
+    '{"model":"b","weight":{"type":"training_table","base_weight":1.0,'
+    '"min_weight":0.5,"max_weight":2.0}}]}': (
+        "5WgMPWDgklyMlO9deN6uqN", "7hoX5b7QpvUZ1IwfhUitW6",
+        "7YTiM3lOZYNn6lxZVj5aiK"),
+}
+
+
+def test_golden_panel_ids():
+    for body, (eid, emc, ett) in GOLDEN_PANEL_IDS.items():
+        m = ModelBase.from_json(body).into_model_validate()
+        assert m.id == eid, f"panel id drift for {body}: {m.id} != {eid}"
+        assert m.multichat_id == emc, (
+            f"panel multichat_id drift for {body}: {m.multichat_id} != {emc}")
+        assert m.training_table_id == ett, (
+            f"panel training_table_id drift for {body}: "
+            f"{m.training_table_id} != {ett}")
+
+
 def test_model_assembly_sorted_and_indexed():
     m = ModelBase.from_json(
         '{"llms":[{"model":"zeta"},{"model":"alpha"},{"model":"alpha","weight":'

@@ -43,6 +43,11 @@ import llm_weighted_consensus_tpu.identity.model
 import llm_weighted_consensus_tpu.errors
 import llm_weighted_consensus_tpu.weights
 import llm_weighted_consensus_tpu.ballot
+import llm_weighted_consensus_tpu.cache
+import llm_weighted_consensus_tpu.cache.fingerprint
+import llm_weighted_consensus_tpu.cache.store
+import llm_weighted_consensus_tpu.cache.singleflight
+import llm_weighted_consensus_tpu.cache.replay
 
 import json as _json
 loaded = sorted(

@@ -48,7 +48,9 @@ def test_ring_attention_matches_full(sp):
 
     mesh = sp_mesh(sp)
     spec = P(None, "sp")
-    ringed = jax.shard_map(
+    from llm_weighted_consensus_tpu.parallel.compat import shard_map
+
+    ringed = shard_map(
         lambda q, k, v, b: ring.ring_attention(q, k, v, b, scale, "sp"),
         mesh=mesh,
         in_specs=(spec, spec, spec, spec),

@@ -30,6 +30,15 @@ counters in the same one-JSON-line format.  ``cold`` starts the repeat
 run on an empty cache (first request is the miss that fills it; the
 in-flight rest collapse onto it); ``warm`` primes the entry untimed
 first so every timed request is a pure hit.
+
+``--faults [SPEC]`` replaces the trio with the resilience scenario
+(resilience/): the service starts with ``FAULT_PLAN`` injecting seeded
+stalls at the transport seam and ``RESILIENCE_QUORUM`` arming the
+weight-quorum early exit, then a 3-judge score body is driven K times.
+Reports the degraded-response rate and p50/p99 under injected stalls
+plus the served /metrics ``resilience`` counters — the number that
+matters is p99: with the quorum on, a stalled judge costs a ``degraded:
+true`` frame instead of a stall-length tail latency.
 """
 
 from __future__ import annotations
@@ -80,6 +89,7 @@ async def _start_service(
     window_ms: float,
     quantize: str = "none",
     cache_ttl_sec: float = 0.0,
+    extra_env: dict = None,
 ):
     """The real service on real localhost TCP sockets (fake upstream
     included), exactly as ``python -m ...serve --fake-upstream`` wires it."""
@@ -112,6 +122,7 @@ async def _start_service(
                 if cache_ttl_sec > 0
                 else {}
             ),
+            **(extra_env or {}),
         }
     )
     app = build_service(
@@ -412,6 +423,90 @@ async def bench_score_cache(session, base, requests, concurrency, mode):
     )
 
 
+def _sse_objs(text: str) -> list:
+    """Decode every ``data:`` frame of an SSE body (skipping [DONE])."""
+    objs = []
+    for frame in text.split("\n\n"):
+        for line in frame.splitlines():
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload.strip() == "[DONE]":
+                continue
+            try:
+                objs.append(json.loads(payload))
+            except ValueError:
+                pass
+    return objs
+
+
+async def bench_score_faults(session, base, requests, concurrency, spec):
+    """Streaming /score/completions under injected stalls: the quorum
+    early exit trades a stalled judge for a ``degraded: true`` frame, so
+    the numbers to watch are degraded_rate and the p99 it buys."""
+    body = json.dumps(
+        {
+            "stream": True,
+            "messages": [{"role": "user", "content": "pick the best"}],
+            "model": {
+                "llms": [{"model": f"fake-judge-{g}"} for g in range(3)]
+            },
+            "choices": ["candidate a", "candidate b"],
+        }
+    )
+
+    sem = asyncio.Semaphore(concurrency)
+    lat = []
+    degraded = 0
+    errors = 0
+
+    async def one():
+        nonlocal degraded, errors
+        async with sem:
+            t0 = time.perf_counter()
+            async with session.post(
+                base + "/score/completions", data=body
+            ) as resp:
+                text = await resp.text()
+                if resp.status != 200:
+                    errors += 1
+                    return
+            lat.append((time.perf_counter() - t0) * 1e3)
+            if any(o.get("degraded") for o in _sse_objs(text)):
+                degraded += 1
+
+    # one untimed warmup to pay handler/jit setup (it draws one slot of
+    # the seeded plan; the timed sample stays deterministic given K)
+    await one()
+    lat.clear()
+    degraded = 0
+    errors = 0
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one() for _ in range(requests)))
+    total = time.perf_counter() - t0
+
+    async with session.get(base + "/metrics") as resp:
+        resilience = (await resp.json()).get("resilience")
+
+    emit(
+        "/score/completions?faults",
+        len(lat) / total if total else 0.0,
+        "requests/sec",
+        **_percentiles(lat),
+        requests=requests,
+        concurrency=concurrency,
+        fault_plan=spec,
+        degraded_rate=round(degraded / max(1, requests), 3),
+        error_rate=round(errors / max(1, requests), 3),
+        resilience=resilience,
+        note=(
+            "3-judge streaming score under FAULT_PLAN stalls; "
+            "RESILIENCE_QUORUM=0.6 cancels unflippable stragglers, so "
+            "a stalled judge costs degraded:true instead of p99"
+        ),
+    )
+
+
 async def main_async(args) -> None:
     import aiohttp
 
@@ -422,12 +517,23 @@ async def main_async(args) -> None:
         cache_ttl_sec=(
             600.0 if args.cache in ("cold", "warm") else 0.0
         ),
+        extra_env=(
+            {"FAULT_PLAN": args.faults, "RESILIENCE_QUORUM": "0.6"}
+            if args.faults is not None
+            else None
+        ),
     )
     base = f"http://127.0.0.1:{port}"
     try:
         async with aiohttp.ClientSession(
             headers={"content-type": "application/json"}
         ) as session:
+            if args.faults is not None:
+                await bench_score_faults(
+                    session, base, args.requests, args.concurrency,
+                    args.faults,
+                )
+                return
             if args.cache is not None:
                 await bench_score_cache(
                     session, base, args.requests, args.concurrency,
@@ -474,6 +580,17 @@ def main() -> None:
         "trio: same score request replayed K times, hit vs miss p50/p95 "
         "(off = cache disabled baseline, cold = first repeat fills the "
         "entry inside the timed window, warm = entry primed untimed)",
+    )
+    parser.add_argument(
+        "--faults",
+        nargs="?",
+        default=None,
+        const="seed=42,stall_first=0.2,stall_mid=0.1,stall_ms=400",
+        metavar="SPEC",
+        help="run the resilience scenario instead of the endpoint trio: "
+        "service started with FAULT_PLAN=SPEC (default: seeded 30%% "
+        "stall mix) + RESILIENCE_QUORUM=0.6; reports degraded-response "
+        "rate and p99 under the injected stalls",
     )
     parser.add_argument("--n", type=int, default=64)
     parser.add_argument("--requests", type=int, default=100)

@@ -15,7 +15,11 @@ Recording is conservative about what it considers a cacheable outcome:
   whole stream uncacheable;
 * any per-choice error inside a chunk (a judge that failed) marks it
   uncacheable too — a transient upstream failure must not be pinned for
-  a full TTL.
+  a full TTL;
+* a ``degraded: true`` frame (weight-quorum early exit / deadline
+  expiry with a partial panel — resilience/) marks it uncacheable: a
+  degraded consensus is an emergency answer, never an authoritative one,
+  and the next identical request should get a full-panel attempt.
 
 Frames are snapshotted via ``to_json_obj()`` *before* they are yielded,
 so no downstream consumer (unary fold, archiving tee) can mutate the
@@ -42,7 +46,9 @@ async def record_stream(
             if isinstance(item, BaseException):
                 cacheable = False
             elif cacheable:
-                if any(c.error is not None for c in item.choices):
+                if getattr(item, "degraded", None) or any(
+                    c.error is not None for c in item.choices
+                ):
                     cacheable = False
                     chunk_objs = []
                 else:

@@ -36,8 +36,10 @@ from typing import AsyncIterator, Optional
 from .. import archive as archive_mod
 from ..errors import (
     BadStatusError,
+    BreakerOpenError,
     ChatError,
     CtxHandlerError,
+    DeadlineExceededError,
     DeserializationError,
     EmptyStreamError,
     ProviderError,
@@ -45,6 +47,7 @@ from ..errors import (
     StreamTimeoutError,
     TransportError,
 )
+from ..resilience import current_deadline, current_retry_budget
 from ..types.base import SchemaError, fold_chunks
 from ..types.chat_request import ChatCompletionCreateParams, StreamOptions
 from ..types.chat_response import ChatCompletion, ChatCompletionChunk
@@ -140,8 +143,9 @@ class Transport:
 class AiohttpTransport(Transport):
     """Real HTTP transport; lazily creates one shared aiohttp session."""
 
-    def __init__(self) -> None:
+    def __init__(self, connect_timeout_ms: float = 30000.0) -> None:
         self._session = None
+        self.connect_timeout_ms = connect_timeout_ms
 
     def _get_session(self):
         import aiohttp
@@ -150,7 +154,9 @@ class AiohttpTransport(Transport):
             # no total timeout: streams are bounded by the client's own
             # per-chunk timeouts
             self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30)
+                timeout=aiohttp.ClientTimeout(
+                    total=None, sock_connect=self.connect_timeout_ms / 1000.0
+                )
             )
         return self._session
 
@@ -239,6 +245,7 @@ class DefaultChatClient(ChatClient):
         other_chunk_timeout_ms: float = 60000.0,
         ctx_handler: Optional[CtxHandler] = None,
         archive_fetcher: Optional[archive_mod.Fetcher] = None,
+        resilience=None,
     ) -> None:
         self.transport = transport
         self.api_bases = list(api_bases)
@@ -250,6 +257,11 @@ class DefaultChatClient(ChatClient):
         self.other_chunk_timeout_ms = other_chunk_timeout_ms
         self.ctx_handler = ctx_handler or CtxHandler()
         self.archive_fetcher = archive_fetcher or archive_mod.UnimplementedFetcher()
+        # optional resilience.ResiliencePolicy: breakers, hedging, counters.
+        # None (the default) preserves pre-resilience behavior exactly; the
+        # ambient retry budget / deadline contextvars are still honored
+        # because activating them is itself opt-in upstream.
+        self.resilience = resilience
         # compile/load the native SSE parser NOW (sync startup context) so
         # make_parser() inside the async decode loop never blocks the loop
         # on a g++ run
@@ -306,22 +318,120 @@ class DefaultChatClient(ChatClient):
         sleeps = self.backoff.sleeps()
         while True:
             for i, attempt in enumerate(attempts):
-                request.model = attempt.model
-                stream = self._open_event_stream(attempt.api_base, request)
-                # first-chunk peek: commit only on a good first chunk
-                try:
-                    first = await stream.__anext__()
-                except StopAsyncIteration:
-                    first = EmptyStreamError()
-                if isinstance(first, ChatError):
-                    last_error = first
-                    await stream.aclose()
+                result = await self._attempt_maybe_hedged(attempts, i, request)
+                if isinstance(result, ChatError):
+                    last_error = result
                     continue
-                return _prepend(first, stream), attempt.api_base
+                return result
             sleep = next(sleeps, None)
             if sleep is None:
                 raise last_error if last_error is not None else EmptyStreamError()
+            deadline = current_deadline()
+            if deadline is not None:
+                if deadline.expired():
+                    self._inc("deadline_expired")
+                    raise DeadlineExceededError("retry loop")
+                # never sleep past the deadline: wake with whatever budget
+                # is left and let the next attempt's clamped timeouts decide
+                sleep = min(sleep, deadline.remaining())
+            budget = current_retry_budget()
+            if budget is not None and not budget.try_acquire():
+                # the fan-out's shared retry budget is dry: fail this judge
+                # over to its error path instead of joining a retry storm
+                self._inc("retry_denied")
+                raise last_error if last_error is not None else EmptyStreamError()
             await asyncio.sleep(sleep)
+
+    # -- resilience-aware attempt machinery ----------------------------------
+
+    def _inc(self, name: str) -> None:
+        if self.resilience is not None:
+            self.resilience.inc(name)
+
+    async def _attempt_maybe_hedged(self, attempts, i, request):
+        """One slot of the attempt matrix; with hedging enabled, a backup
+        against the next endpoint races the primary after the hedge delay
+        (Dean & Barroso: the loser is cancelled, extra load is bounded by
+        how rarely the delay fires)."""
+        policy = self.resilience
+        hedge = policy.hedge if policy is not None else None
+        if hedge is None or not hedge.enabled or len(attempts) < 2:
+            return await self._open_committed(attempts[i], request)
+
+        primary = asyncio.create_task(self._open_committed(attempts[i], request))
+        delay = hedge.delay_ms_effective() / 1000.0
+        deadline = current_deadline()
+        if deadline is not None:
+            delay = min(delay, deadline.remaining())
+        done, _ = await asyncio.wait({primary}, timeout=delay)
+        if primary in done:
+            return primary.result()
+
+        self._inc("hedge_launched")
+        backup = asyncio.create_task(
+            self._open_committed(attempts[(i + 1) % len(attempts)], request)
+        )
+        tasks = {primary, backup}
+        last: Optional[ChatError] = None
+        while tasks:
+            done, tasks = await asyncio.wait(
+                tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            winner = None
+            for task in done:
+                result = task.result()
+                if isinstance(result, ChatError):
+                    last = result
+                elif winner is None:
+                    winner = (task, result)
+                else:
+                    # both committed in one wake-up: keep the first, close
+                    # the duplicate stream
+                    await _close_committed(result)
+            if winner is not None:
+                if winner[0] is backup:
+                    self._inc("hedge_won")
+                await _discard_attempts(tasks)
+                return winner[1]
+        return last
+
+    async def _open_committed(self, attempt, request):
+        """One attempt end to end: breaker gate, open, first-chunk peek.
+
+        Returns ``(stream, api_base)`` on commit or the ``ChatError`` that
+        felled it; the outcome lands on the attempt's breaker and a commit's
+        first-chunk latency feeds the hedge tracker."""
+        policy = self.resilience
+        breaker = None
+        if policy is not None and policy.breakers is not None:
+            breaker = policy.breakers.get(attempt.api_base.api_base, attempt.model)
+            if not breaker.allow():
+                self._inc("breaker_rejected")
+                return BreakerOpenError(attempt.api_base.api_base, attempt.model)
+        # per-attempt clone: hedged attempts run concurrently and must not
+        # race on the shared request's model field
+        req = request.clone()
+        req.model = attempt.model
+        start = time.monotonic()
+        stream = self._open_event_stream(attempt.api_base, req)
+        # first-chunk peek: commit only on a good first chunk
+        try:
+            first = await stream.__anext__()
+        except StopAsyncIteration:
+            first = EmptyStreamError()
+        if isinstance(first, ChatError):
+            await stream.aclose()
+            if breaker is not None:
+                if _breaker_failure(first):
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+            return first
+        if breaker is not None:
+            breaker.record_success()
+        if policy is not None and policy.hedge is not None:
+            policy.hedge.observe((time.monotonic() - start) * 1000.0)
+        return _prepend(first, stream), attempt.api_base
 
     # -- stream machinery ---------------------------------------------------
 
@@ -348,6 +458,9 @@ class DefaultChatClient(ChatClient):
         """
         url = f"{api_base.api_base.rstrip('/')}/chat/completions"
         body = jsonutil.dumps(request.to_json_obj()).encode("utf-8")
+        # propagated per-request deadline (None unless the gateway set one):
+        # every wait below is clamped to its remaining budget
+        deadline = current_deadline()
         try:
             resp = await self.transport.post_sse(url, self._headers(api_base), body)
         except ChatError as e:
@@ -359,12 +472,14 @@ class DefaultChatClient(ChatClient):
 
         try:
             if not (200 <= resp.status < 300):
+                started = time.monotonic()
                 try:
                     raw = await asyncio.wait_for(
-                        resp.read_body(), self.first_chunk_timeout_ms / 1000.0
+                        resp.read_body(),
+                        _clamp(self.first_chunk_timeout_ms, deadline),
                     )
                 except asyncio.TimeoutError:
-                    yield StreamTimeoutError()
+                    yield _timeout_error("first_chunk", started, deadline)
                     return
                 try:
                     parsed = jsonutil.loads(raw.decode("utf-8", errors="replace"))
@@ -382,11 +497,14 @@ class DefaultChatClient(ChatClient):
                 # per-chunk timeout tiers (client.rs:334-354; defaults
                 # main.rs:17-20)
                 if not pending:
-                    timeout = (
+                    tier = "first_chunk" if first else "other_chunk"
+                    timeout = _clamp(
                         self.first_chunk_timeout_ms
                         if first
-                        else self.other_chunk_timeout_ms
-                    ) / 1000.0
+                        else self.other_chunk_timeout_ms,
+                        deadline,
+                    )
+                    started = time.monotonic()
                     try:
                         data = await asyncio.wait_for(
                             byte_iter.__anext__(), timeout
@@ -399,7 +517,7 @@ class DefaultChatClient(ChatClient):
                             return
                         data = None
                     except asyncio.TimeoutError:
-                        yield StreamTimeoutError()
+                        yield _timeout_error(tier, started, deadline)
                         return
                     except Exception as e:
                         yield TransportError(str(e))
@@ -439,6 +557,54 @@ class DefaultChatClient(ChatClient):
                     user_id=obj.get("user_id"),
                 )
             return DeserializationError(str(e))
+
+
+def _clamp(timeout_ms: float, deadline) -> float:
+    """A tier timeout clamped to the remaining request deadline."""
+    timeout = timeout_ms / 1000.0
+    if deadline is not None:
+        timeout = min(timeout, deadline.remaining())
+    return timeout
+
+
+def _timeout_error(tier: str, started: float, deadline) -> ChatError:
+    """TimeoutError -> taxonomy: the deadline expiring is reported as such
+    (it is this request's budget, not the upstream's slowness)."""
+    if deadline is not None and deadline.expired():
+        return DeadlineExceededError(f"{tier} wait")
+    return StreamTimeoutError(tier, (time.monotonic() - started) * 1000.0)
+
+
+def _breaker_failure(err: ChatError) -> bool:
+    """Upstream-health classification: transport failures, timeouts and
+    5xx/429 count against the breaker; any other 4xx means the upstream is
+    alive and answering (a bad request is our fault, not its health), and a
+    deadline expiry is our budget running out, not the upstream's fault."""
+    if isinstance(err, DeadlineExceededError):
+        return False
+    if isinstance(err, (TransportError, StreamTimeoutError, EmptyStreamError)):
+        return True
+    if isinstance(err, BadStatusError):
+        return err.code >= 500 or err.code == 429
+    return False
+
+
+async def _close_committed(result) -> None:
+    """Close a committed (stream, api_base) that lost the hedge race."""
+    stream = result[0]
+    aclose = getattr(stream, "aclose", None)
+    if aclose is not None:
+        await aclose()
+
+
+async def _discard_attempts(tasks) -> None:
+    """Cancel in-flight hedge losers; close any that committed anyway."""
+    for task in tasks:
+        task.cancel()
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    for result in results:
+        if isinstance(result, tuple):
+            await _close_committed(result)
 
 
 async def _try_join(*coros):

@@ -62,6 +62,7 @@ from ..errors import (
     to_response_error,
 )
 from ..identity.model import Model, ModelBase
+from ..resilience import QuorumTracker, RetryBudget, current_deadline
 from ..types import chat_request, score_request
 from ..types.base import SchemaError, fold_chunks
 from ..types.chat_response import Usage
@@ -373,6 +374,7 @@ class ScoreClient:
         ballot_sink=None,
         cache=None,
         flights=None,
+        resilience=None,
     ) -> None:
         self.chat_client = chat_client
         self.model_fetcher = model_fetcher
@@ -392,6 +394,10 @@ class ScoreClient:
 
             flights = SingleFlight()
         self.flights = flights
+        # optional resilience.ResiliencePolicy: a per-request retry budget
+        # shared across the judge fan-out and weight-quorum graceful
+        # degradation.  None (the default) = pre-resilience behavior.
+        self.resilience = resilience
 
     # -- unary (client.rs:71-91) --------------------------------------------
 
@@ -583,6 +589,24 @@ class ScoreClient:
         pending_initial = initial_chunk
         indexer = ChoiceIndexer(n_choices)
 
+        policy = self.resilience
+        budget_token = None
+        if policy is not None and policy.retry_budget_tokens > 0:
+            # one bucket for the whole fan-out: the pump tasks the stream
+            # merge spawns inherit it via contextvar, so every judge's
+            # backoff loop draws from the same allotment
+            budget_token = RetryBudget(policy.retry_budget_tokens).activate()
+
+        quorum = None
+        if policy is not None and policy.quorum_fraction > 0:
+            # judge-level tracking (each judge settles on its first final
+            # frame); mirrors the Decimal tally below exactly
+            quorum = QuorumTracker(
+                {llm.index: weights[llm.index] for llm in model.llms},
+                n_choices,
+                policy.quorum_fraction,
+            )
+
         judge_streams = [
             self._judge_stream(
                 ctx, resp_id, created, indexer, llm, weights[llm.index], request
@@ -590,23 +614,72 @@ class ScoreClient:
             for llm in model.llms
         ]
 
-        async for chunk in merge_streams(judge_streams):
-            if pending_initial is not None:
-                yield pending_initial
-                pending_initial = None
-            aggregate.push(chunk)
-            # strip per-judge usage into the running total; interim chunks go
-            # out without it, the final frame carries the sum
-            for choice in chunk.choices:
-                metadata = choice.completion_metadata
-                if metadata is not None and metadata.usage is not None:
-                    usage.push(metadata.usage)
-                    metadata.usage = None
-            yield chunk
+        degraded = False
+        merged = merge_streams(judge_streams)
+        try:
+            async for chunk in merged:
+                if pending_initial is not None:
+                    yield pending_initial
+                    pending_initial = None
+                aggregate.push(chunk)
+                # strip per-judge usage into the running total; interim chunks go
+                # out without it, the final frame carries the sum
+                for choice in chunk.choices:
+                    metadata = choice.completion_metadata
+                    if metadata is not None and metadata.usage is not None:
+                        usage.push(metadata.usage)
+                        metadata.usage = None
+                yield chunk
+                if quorum is not None:
+                    for choice in chunk.choices:
+                        if choice.model_index is None:
+                            continue
+                        if choice.delta.vote is not None:
+                            quorum.record_vote(
+                                choice.model_index, choice.delta.vote
+                            )
+                        elif choice.error is not None:
+                            quorum.record_error(choice.model_index)
+                    if quorum.decided():
+                        # stragglers cannot flip the argmax: cancel them
+                        # (closing the merge cancels pumps and judge
+                        # streams, which close their upstreams) and ship
+                        degraded = True
+                        policy.inc("quorum_degraded")
+                        break
+        finally:
+            await merged.aclose()
+            if budget_token is not None:
+                RetryBudget.deactivate(budget_token)
 
         if pending_initial is not None:
             # no judges / no judge produced output: still emit candidates
             yield pending_initial
+
+        if degraded and quorum is not None:
+            # synthesize per-judge failure detail for the cancelled
+            # stragglers; pushed into the aggregate so the tally and the
+            # final frame see them like any other errored judge
+            straggler_chunk = self._straggler_chunk(
+                resp_id, created, indexer, model, weights, request, quorum
+            )
+            if straggler_chunk is not None:
+                aggregate.push(straggler_chunk)
+                yield straggler_chunk
+
+        if not degraded and policy is not None:
+            deadline = current_deadline()
+            if deadline is not None and deadline.expired():
+                # time ran out with a partial panel: judges that missed the
+                # deadline carry errors, at least one vote landed -> the
+                # consensus ships, marked degraded (all-failed keeps its
+                # AllVotesFailed error path below)
+                tail = aggregate.choices[n_choices:]
+                if any(c.delta.vote is not None for c in tail) and any(
+                    c.error is not None for c in tail
+                ):
+                    degraded = True
+                    policy.inc("deadline_degraded")
 
         # tally + all-error detection (client.rs:384-416)
         from decimal import Decimal
@@ -638,6 +711,8 @@ class ScoreClient:
         aggregate.weight_data = weight_data
         usage.with_total_cost()
         aggregate.usage = usage
+        if degraded:
+            aggregate.degraded = True
         for choice in aggregate.choices:
             if choice.index < n_choices:
                 w = choice_weight[choice.index]
@@ -659,11 +734,53 @@ class ScoreClient:
             choice.delta = Delta()
             choice.finish_reason = None
             choice.logprobs = None
-            choice.error = None
+            if not degraded:
+                choice.error = None
+            # degraded: keep per-judge failure detail on the final frame so
+            # unary consumers see WHY the panel is partial
         yield aggregate
 
         if all_error and len(model.llms) > 0:
             yield AllVotesFailed(all_error_code)
+
+    @staticmethod
+    def _straggler_chunk(
+        resp_id, created, indexer, model, weights, request, quorum
+    ):
+        """Error choices for judges cancelled by the quorum early exit."""
+        pending = sorted(quorum.pending())
+        if not pending:
+            return None
+        llms_by_index = {llm.index: llm for llm in model.llms}
+        choices = []
+        for judge_index in pending:
+            quorum.record_error(judge_index)
+            llm = llms_by_index.get(judge_index)
+            choices.append(
+                StreamingChoice(
+                    delta=Delta(),
+                    finish_reason="error",
+                    index=indexer.get(judge_index, 0),
+                    logprobs=None,
+                    weight=weights[judge_index],
+                    confidence=None,
+                    error=ResponseError(
+                        code=499,
+                        message="straggler cancelled: weight quorum reached",
+                    ),
+                    model=llm.id if llm is not None else None,
+                    model_index=judge_index,
+                    completion_metadata=None,
+                )
+            )
+        return ChatCompletionChunk(
+            id=resp_id,
+            choices=choices,
+            created=created,
+            model=request.model,
+            usage=None,
+            weight_data=None,
+        )
 
     # -- per-judge ballot stream (client.rs:467-908) ------------------------
 

@@ -99,8 +99,49 @@ class BadStatusError(ChatError):
 
 
 class StreamTimeoutError(ChatError):
-    def __init__(self):
-        super().__init__("stream_timeout", "error fetching stream: timeout", 500)
+    """Per-chunk stream timeout; records which tier fired and how long it
+    actually waited (``tier`` is ``"first_chunk"`` or ``"other_chunk"``).
+    The argless form keeps the reference's constant message."""
+
+    def __init__(
+        self,
+        tier: Optional[str] = None,
+        elapsed_ms: Optional[float] = None,
+    ):
+        if tier is None:
+            message = "error fetching stream: timeout"
+        elif elapsed_ms is None:
+            message = f"error fetching stream: {tier} timeout"
+        else:
+            message = (
+                f"error fetching stream: {tier} timeout after {elapsed_ms:.0f}ms"
+            )
+        super().__init__("stream_timeout", message, 500)
+        self.tier = tier
+        self.elapsed_ms = elapsed_ms
+
+
+class BreakerOpenError(ChatError):
+    """Attempt refused locally: the upstream's circuit breaker is open."""
+
+    def __init__(self, api_base: str, model: str):
+        super().__init__(
+            "breaker_open",
+            f"circuit breaker open for {api_base}|{model}",
+            503,
+        )
+        self.api_base = api_base
+        self.model = model
+
+
+class DeadlineExceededError(ChatError):
+    """The request's propagated deadline ran out mid-attempt."""
+
+    def __init__(self, detail: Optional[str] = None):
+        message = "request deadline exceeded"
+        if detail:
+            message = f"{message}: {detail}"
+        super().__init__("deadline_exceeded", message, 504)
 
 
 class CtxHandlerError(ChatError):

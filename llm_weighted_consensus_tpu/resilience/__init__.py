@@ -1,0 +1,98 @@
+"""Tail-tolerant fan-out: the serving path's resilience subsystem.
+
+The whole value of weighted consensus is that the panel tolerates
+individual judge failure — but tolerance has to be *engineered* at the
+transport edge, not assumed.  This package supplies the mechanisms, each
+one its own module with a pure, clock-injectable core:
+
+* ``breaker``   — per-upstream circuit breakers (closed/open/half-open
+  over a sliding failure-rate window, keyed by ``api_base + model``),
+  Nygard's pattern from *Release It!*: a browning-out upstream is skipped
+  outright instead of timing out every judge that touches it;
+* ``budget``    — a shared retry budget (token bucket) so the N judges
+  of one score request cannot collectively retry-storm an upstream that
+  is already failing;
+* ``hedge``     — hedged requests in the spirit of Dean & Barroso's
+  *The Tail at Scale*: after a delay (static, or an observed-latency
+  quantile), a backup attempt races the primary and the loser is
+  cancelled;
+* ``deadline``  — a per-request deadline set at the gateway flows
+  through the score fan-out into every chat attempt via a contextvar,
+  so backoff/retry/hedge decisions respect the remaining budget instead
+  of a fixed elapsed cap;
+* ``quorum``    — weight-quorum graceful degradation: once enough panel
+  weight has voted that the stragglers cannot flip the argmax, they are
+  cancelled and the final frame ships with ``degraded: true``;
+* ``faults``    — a deterministic, seeded fault-injection ``Transport``
+  (connect refusal, 5xx, stalls, malformed SSE, truncation) so every
+  degradation path above is exercised in tests instead of discovered in
+  production.
+
+Everything is opt-in: a ``ResiliencePolicy`` of ``None`` (the default
+everywhere) preserves pre-resilience behavior byte-for-byte.  Pure-core
+hygiene: nothing here imports jax or aiohttp at module scope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .breaker import BreakerConfig, BreakerRegistry, CircuitBreaker  # noqa: F401
+from .budget import RetryBudget, current_retry_budget  # noqa: F401
+from .deadline import Deadline, current_deadline  # noqa: F401
+from .faults import FaultInjectionTransport, FaultPlan  # noqa: F401
+from .hedge import HedgePolicy, LatencyTracker  # noqa: F401
+from .quorum import QuorumTracker  # noqa: F401
+
+
+@dataclass
+class ResiliencePolicy:
+    """One bundle wired through the client and serving layers.
+
+    Every member defaults to "off"; ``enabled`` properties gate each
+    feature independently so e.g. breakers can run without hedging.
+    Counters are plain ints mutated from the (single-threaded) event
+    loop; ``snapshot()`` is the ``/metrics`` provider payload.
+    """
+
+    breakers: Optional[BreakerRegistry] = None
+    hedge: Optional[HedgePolicy] = None
+    # retries each score request's fan-out may spend collectively;
+    # 0 = unlimited (no budget attached)
+    retry_budget_tokens: int = 0
+    # fraction of total panel weight that must settle before the
+    # quorum early-exit is considered; 0 = disabled
+    quorum_fraction: float = 0.0
+    # default per-request deadline the gateway applies when the client
+    # sends no x-deadline-ms header; 0 = none
+    deadline_ms: float = 0.0
+    counters: dict = field(default_factory=dict)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def snapshot(self) -> dict:
+        out = {"counters": dict(self.counters)}
+        if self.breakers is not None:
+            out["breakers"] = self.breakers.snapshot()
+        if self.hedge is not None and self.hedge.tracker is not None:
+            out["hedge_delay_ms"] = round(self.hedge.delay_ms_effective(), 2)
+        return out
+
+
+__all__ = [
+    "BreakerConfig",
+    "BreakerRegistry",
+    "CircuitBreaker",
+    "Deadline",
+    "FaultInjectionTransport",
+    "FaultPlan",
+    "HedgePolicy",
+    "LatencyTracker",
+    "QuorumTracker",
+    "ResiliencePolicy",
+    "RetryBudget",
+    "current_deadline",
+    "current_retry_budget",
+]

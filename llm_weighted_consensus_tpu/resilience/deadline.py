@@ -1,0 +1,62 @@
+"""Deadline propagation: one per-request time budget, ambient everywhere.
+
+The gateway stamps a ``Deadline`` when the request arrives (from the
+client's ``x-deadline-ms`` header or the configured default) and
+activates it on a contextvar.  Every layer below — the score fan-out's
+merge loop, the chat client's retry/backoff/hedge decisions, the
+per-chunk stream timeouts — reads ``current_deadline()`` and clamps its
+own waits to ``remaining()``, so a request that has 800 ms left never
+starts a 10 s first-chunk wait or a 1 s backoff sleep.
+
+Propagation rides asyncio's context inheritance: tasks created while
+the deadline is active (the stream-merge pump tasks, hedge attempts)
+carry a copy automatically.  No deadline active = every clamp is a
+no-op — the pre-resilience fixed-timeout behavior, byte for byte.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Callable, Optional
+
+_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
+    "lwc_deadline", default=None
+)
+
+
+class Deadline:
+    def __init__(
+        self,
+        seconds: float,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.clock = clock
+        self._expires_at = clock() + max(0.0, float(seconds))
+
+    def remaining(self) -> float:
+        """Seconds left; never negative."""
+        return max(0.0, self._expires_at - self.clock())
+
+    def expired(self) -> bool:
+        return self.clock() >= self._expires_at
+
+    def clamp(self, timeout: Optional[float]) -> float:
+        """The smaller of ``timeout`` and the remaining budget (a
+        ``timeout`` of None means 'only the deadline bounds this')."""
+        rem = self.remaining()
+        return rem if timeout is None else min(timeout, rem)
+
+    # -- contextvar scope -----------------------------------------------------
+
+    def activate(self) -> contextvars.Token:
+        return _DEADLINE.set(self)
+
+    @staticmethod
+    def deactivate(token: contextvars.Token) -> None:
+        _DEADLINE.reset(token)
+
+
+def current_deadline() -> Optional[Deadline]:
+    return _DEADLINE.get()

@@ -1,0 +1,211 @@
+"""Deterministic fault injection at the Transport seam.
+
+``FaultInjectionTransport`` wraps any real ``Transport`` and, per
+request, consults a seeded ``FaultPlan`` for one of six fault kinds —
+the failure modes an OpenAI-compatible SSE upstream actually exhibits:
+
+* ``connect``      — connection refused (``TransportError`` before any
+  response exists);
+* ``5xx``          — synthetic 503 with a JSON error body (the bad-status
+  path, no stream);
+* ``stall_first``  — delay before the first byte chunk (trips the
+  first-chunk timeout tier / the hedge delay);
+* ``stall_mid``    — delay mid-stream after bytes have flowed (trips the
+  other-chunk tier — the committed-stream failure mode);
+* ``malformed``    — an invalid SSE data frame injected mid-stream
+  (exercises per-frame decode-error tolerance);
+* ``truncate``     — stream ends early without ``[DONE]``.
+
+Determinism: one ``random.Random(seed)`` drawn once per request in
+request order, so a single-threaded test driving requests in a fixed
+order sees the exact same fault sequence every run.  For tests that
+want full control, ``FaultPlan.scripted([...])`` replays an explicit
+fault list instead of sampling.
+
+Selectable in production-shaped runs via the ``FAULT_PLAN`` env spec,
+e.g. ``seed=42,connect=0.1,5xx=0.1,stall_first=0.1,stall_ms=200``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import AsyncIterator, Dict, List, Optional
+
+# fault kinds, in the fixed order the sampler walks (order is part of
+# the determinism contract — do not reorder)
+CONNECT = "connect"
+BAD_STATUS = "5xx"
+STALL_FIRST = "stall_first"
+STALL_MID = "stall_mid"
+MALFORMED = "malformed"
+TRUNCATE = "truncate"
+
+KINDS = (CONNECT, BAD_STATUS, STALL_FIRST, STALL_MID, MALFORMED, TRUNCATE)
+
+_MALFORMED_FRAME = b"data: {this is not json\n\n"
+
+
+class FaultPlan:
+    """Per-request fault schedule: seeded sampling or an explicit script."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        probabilities: Optional[Dict[str, float]] = None,
+        stall_ms: float = 100.0,
+        script: Optional[List[Optional[str]]] = None,
+    ) -> None:
+        self.seed = int(seed)
+        self.rng = random.Random(self.seed)
+        self.probabilities = {
+            kind: float((probabilities or {}).get(kind, 0.0)) for kind in KINDS
+        }
+        self.stall_ms = float(stall_ms)
+        self._script = list(script) if script is not None else None
+        self._script_pos = 0
+        self.requests = 0
+        self.injected: Dict[str, int] = {kind: 0 for kind in KINDS}
+
+    @classmethod
+    def scripted(
+        cls, faults: List[Optional[str]], *, stall_ms: float = 100.0
+    ) -> "FaultPlan":
+        """Replay ``faults`` verbatim (None = healthy request); healthy
+        after exhaustion."""
+        return cls(script=faults, stall_ms=stall_ms)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse a ``FAULT_PLAN`` env spec.
+
+        Comma-separated ``key=value``: ``seed``, ``stall_ms``, one key
+        per fault kind with its probability, or ``script=a|b|ok|c``
+        (``ok``/empty = healthy slot).
+        """
+        seed = 0
+        stall_ms = 100.0
+        probs: Dict[str, float] = {}
+        script: Optional[List[Optional[str]]] = None
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"FAULT_PLAN: expected key=value, got {part!r}")
+            key, _, value = part.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if key == "seed":
+                seed = int(value)
+            elif key == "stall_ms":
+                stall_ms = float(value)
+            elif key == "script":
+                script = [
+                    None if slot in ("", "ok") else slot
+                    for slot in value.split("|")
+                ]
+                for slot in script:
+                    if slot is not None and slot not in KINDS:
+                        raise ValueError(f"FAULT_PLAN: unknown fault {slot!r}")
+            elif key in KINDS:
+                probs[key] = float(value)
+            else:
+                raise ValueError(f"FAULT_PLAN: unknown key {key!r}")
+        return cls(seed=seed, probabilities=probs, stall_ms=stall_ms, script=script)
+
+    def next_fault(self) -> Optional[str]:
+        """The fault for the next request (None = healthy)."""
+        self.requests += 1
+        if self._script is not None:
+            if self._script_pos >= len(self._script):
+                return None
+            fault = self._script[self._script_pos]
+            self._script_pos += 1
+            if fault is not None:
+                self.injected[fault] += 1
+            return fault
+        draw = self.rng.random()
+        edge = 0.0
+        for kind in KINDS:
+            edge += self.probabilities[kind]
+            if draw < edge:
+                self.injected[kind] += 1
+                return kind
+        return None
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": self.requests,
+            "injected": {k: v for k, v in self.injected.items() if v},
+        }
+
+
+class _SyntheticBadStatus:
+    """A response-shaped 503 that never touched the network."""
+
+    status = 503
+
+    async def read_body(self) -> bytes:
+        return b'{"error":{"code":503,"message":"fault-injected upstream error"}}'
+
+    async def byte_stream(self) -> AsyncIterator[bytes]:
+        return
+        yield b""  # pragma: no cover — makes this an async generator
+
+    async def close(self) -> None:
+        pass
+
+
+class _FaultedResponse:
+    """Delegates to the real response, perturbing the byte stream."""
+
+    def __init__(self, inner, fault: Optional[str], stall_s: float) -> None:
+        self._inner = inner
+        self._fault = fault
+        self._stall_s = stall_s
+        self.status = inner.status
+
+    async def read_body(self) -> bytes:
+        return await self._inner.read_body()
+
+    async def byte_stream(self) -> AsyncIterator[bytes]:
+        seen = 0
+        async for data in self._inner.byte_stream():
+            if seen == 0 and self._fault == STALL_FIRST:
+                await asyncio.sleep(self._stall_s)
+            if seen == 1 and self._fault == STALL_MID:
+                await asyncio.sleep(self._stall_s)
+            yield data
+            seen += 1
+            if self._fault == MALFORMED and seen == 1:
+                yield _MALFORMED_FRAME
+            if self._fault == TRUNCATE and seen >= 1:
+                return
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+
+class FaultInjectionTransport:
+    """A ``Transport`` decorator: same interface, scheduled misbehavior."""
+
+    def __init__(self, inner, plan: FaultPlan) -> None:
+        self.inner = inner
+        self.plan = plan
+
+    async def post_sse(self, url: str, headers: dict, body: bytes):
+        from ..errors import TransportError
+
+        fault = self.plan.next_fault()
+        if fault == CONNECT:
+            raise TransportError("fault-injected connection refused")
+        if fault == BAD_STATUS:
+            return _SyntheticBadStatus()
+        resp = await self.inner.post_sse(url, headers, body)
+        if fault is None:
+            return resp
+        return _FaultedResponse(resp, fault, self.plan.stall_ms / 1000.0)
+
+    async def close(self) -> None:
+        await self.inner.close()

@@ -622,7 +622,17 @@ def build_service(
         )
         if not os.path.exists(config.archive_path):
             store.save(config.archive_path)
-    transport = AiohttpTransport()
+    transport = AiohttpTransport(
+        connect_timeout_ms=config.connect_timeout_millis
+    )
+    # FAULT_PLAN (chaos runs): wrap the real transport in the seeded
+    # fault injector; the wrapper's close() closes the inner session
+    fault_plan = config.fault_injection_plan()
+    if fault_plan is not None:
+        from ..resilience import FaultInjectionTransport
+
+        transport = FaultInjectionTransport(transport, fault_plan)
+    resilience = config.resilience_policy()
     chat_client = DefaultChatClient(
         transport,
         api_bases,
@@ -633,6 +643,7 @@ def build_service(
         first_chunk_timeout_ms=config.first_chunk_timeout_millis,
         other_chunk_timeout_ms=config.other_chunk_timeout_millis,
         archive_fetcher=store,
+        resilience=resilience,
     )
     model_registry = registry.InMemoryModelRegistry()
     # --fake-upstream is demo/test mode: synthetic embedder params are
@@ -710,6 +721,8 @@ def build_service(
         # SCORE_CACHE_TTL > 0: content-addressed result cache with
         # single-flight dedup (cache/); None preserves pre-cache behavior
         cache=score_cache,
+        # RESILIENCE_*: shared retry budget + weight-quorum degradation
+        resilience=resilience,
     )
     multichat_client = MultichatClient(
         chat_client, model_registry, archive_fetcher=store
@@ -752,6 +765,8 @@ def build_service(
         profile_dir=config.profile_dir,
         batcher=batcher,
         reranker=reranker,
+        resilience=resilience,
+        fault_plan=fault_plan,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables

@@ -119,6 +119,43 @@ TPU additions:
 
 Cache counters (hits/misses/evictions/in-flight collapses) surface as
 the ``score_cache`` / ``embed_cache`` sections of ``GET /metrics``.
+
+Resilience (all opt-in; everything unset = pre-resilience behavior,
+byte for byte):
+
+* ``CONNECT_TIMEOUT_MILLIS`` — TCP connect timeout for the upstream
+  HTTP transport (previously hard-coded 30 s).  Default 30000.
+* ``RESILIENCE_BREAKER_THRESHOLD`` — failure rate in (0, 1] that opens
+  a per-upstream circuit breaker (keyed api_base+model).  ``0`` (the
+  default) disables breakers entirely.
+* ``RESILIENCE_BREAKER_WINDOW`` / ``RESILIENCE_BREAKER_MIN_SAMPLES`` /
+  ``RESILIENCE_BREAKER_COOLDOWN_MILLIS`` — sliding-window size, the
+  volume threshold before the rate is meaningful, and how long an open
+  breaker refuses before half-open probing.  Defaults 20 / 5 / 5000.
+* ``RESILIENCE_RETRY_BUDGET`` — retries one score request's judge
+  fan-out may spend collectively (token bucket; anti-retry-storm).
+  ``0`` = unlimited (no budget).
+* ``RESILIENCE_HEDGE_MILLIS`` — static hedge delay: an attempt with no
+  first chunk after this long races a backup against the next endpoint
+  (the loser is cancelled).  ``0`` = no hedging.
+* ``RESILIENCE_HEDGE_QUANTILE`` — hedge at an observed first-chunk
+  latency quantile (e.g. ``0.95``) once enough samples exist, falling
+  back to ``RESILIENCE_HEDGE_MILLIS`` before that.  ``0`` = static only.
+* ``RESILIENCE_DEADLINE_MILLIS`` — default per-request deadline the
+  gateway stamps on score/chat requests (clients override per request
+  via the ``x-deadline-ms`` header); flows through the fan-out so
+  timeouts, backoff sleeps and hedges respect the remaining budget.
+  ``0`` = none.
+* ``RESILIENCE_QUORUM`` — fraction of total panel weight that must
+  settle before the quorum early-exit may cancel stragglers whose votes
+  cannot flip the argmax; the final frame ships with ``degraded: true``
+  (and is never cached).  ``0`` = always wait for the full panel.
+* ``FAULT_PLAN`` — chaos-run fault injection at the transport seam,
+  e.g. ``seed=42,connect=0.1,5xx=0.1,stall_first=0.1,stall_ms=200``
+  (resilience/faults.py).  Never set in production.
+
+Resilience counters + breaker states surface as the ``resilience``
+section of ``GET /metrics``.
 """
 
 from __future__ import annotations
@@ -247,6 +284,8 @@ class Config:
     # stream timeouts (main.rs:17-20)
     first_chunk_timeout_millis: float = 10000.0
     other_chunk_timeout_millis: float = 60000.0
+    # TCP connect timeout (was hard-coded sock_connect=30)
+    connect_timeout_millis: float = 30000.0
     # upstream endpoints (main.rs:21-33)
     openai_apis: list = field(default_factory=list)  # [{api_base, api_key}]
     openai_user_agent: Optional[str] = None
@@ -305,6 +344,20 @@ class Config:
     # whenever the score cache is on
     score_cache_embed: bool = False
     score_cache_embed_max_bytes: int = 32 * 1024 * 1024
+    # resilience subsystem (resilience/): every knob defaults to "off";
+    # resilience_policy() returns None when nothing is enabled so the
+    # clients run their pre-resilience code paths untouched
+    resilience_breaker_threshold: float = 0.0  # 0 = breakers disabled
+    resilience_breaker_window: int = 20
+    resilience_breaker_min_samples: int = 5
+    resilience_breaker_cooldown_millis: float = 5000.0
+    resilience_retry_budget: int = 0  # 0 = unlimited
+    resilience_hedge_millis: float = 0.0  # 0 = no hedging
+    resilience_hedge_quantile: float = 0.0  # 0 = static delay only
+    resilience_deadline_millis: float = 0.0  # 0 = no default deadline
+    resilience_quorum: float = 0.0  # 0 = wait for the full panel
+    # chaos-run fault injection spec (resilience/faults.py); None = off
+    fault_plan: Optional[str] = None
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -342,6 +395,7 @@ class Config:
             other_chunk_timeout_millis=get_f(
                 "OTHER_CHUNK_TIMEOUT_MILLIS", 60000
             ),
+            connect_timeout_millis=get_f("CONNECT_TIMEOUT_MILLIS", 30000),
             openai_apis=apis,
             openai_user_agent=env.get("OPENAI_USER_AGENT"),
             openai_x_title=env.get("OPENAI_X_TITLE"),
@@ -396,7 +450,37 @@ class Config:
             score_cache_embed_max_bytes=_non_negative_int(
                 env, "SCORE_CACHE_EMBED_MAX_BYTES", 32 * 1024 * 1024
             ),
+            resilience_breaker_threshold=get_f(
+                "RESILIENCE_BREAKER_THRESHOLD", 0
+            ),
+            resilience_breaker_window=max(
+                1, int(env.get("RESILIENCE_BREAKER_WINDOW", 20))
+            ),
+            resilience_breaker_min_samples=max(
+                1, int(env.get("RESILIENCE_BREAKER_MIN_SAMPLES", 5))
+            ),
+            resilience_breaker_cooldown_millis=get_f(
+                "RESILIENCE_BREAKER_COOLDOWN_MILLIS", 5000
+            ),
+            resilience_retry_budget=_non_negative_int(
+                env, "RESILIENCE_RETRY_BUDGET", 0
+            ),
+            resilience_hedge_millis=get_f("RESILIENCE_HEDGE_MILLIS", 0),
+            resilience_hedge_quantile=get_f("RESILIENCE_HEDGE_QUANTILE", 0),
+            resilience_deadline_millis=get_f("RESILIENCE_DEADLINE_MILLIS", 0),
+            resilience_quorum=get_f("RESILIENCE_QUORUM", 0),
+            fault_plan=env.get("FAULT_PLAN"),
         )
+        if not 0 <= config.resilience_quorum <= 1:
+            raise ValueError(
+                f"RESILIENCE_QUORUM={config.resilience_quorum} must be a "
+                "weight fraction in [0, 1]"
+            )
+        if not 0 <= config.resilience_hedge_quantile < 1:
+            raise ValueError(
+                f"RESILIENCE_HEDGE_QUANTILE={config.resilience_hedge_quantile}"
+                " must be a quantile in [0, 1)"
+            )
         if config.warmup_r and not config.warmup:
             # same loud-failure contract as _parse_warmup: WARMUP_R names
             # concurrency buckets *per WARMUP shape* — without shapes it
@@ -423,3 +507,53 @@ class Config:
         from ..clients.chat import ApiBase
 
         return [ApiBase.from_json_obj(a) for a in self.openai_apis]
+
+    def resilience_policy(self):
+        """The configured ResiliencePolicy, or None when every knob is off
+        (None keeps the clients on their pre-resilience code paths)."""
+        from ..resilience import (
+            BreakerConfig,
+            BreakerRegistry,
+            HedgePolicy,
+            ResiliencePolicy,
+        )
+
+        breakers = None
+        if self.resilience_breaker_threshold > 0:
+            breakers = BreakerRegistry(
+                BreakerConfig(
+                    threshold=self.resilience_breaker_threshold,
+                    window=self.resilience_breaker_window,
+                    min_samples=self.resilience_breaker_min_samples,
+                    cooldown_ms=self.resilience_breaker_cooldown_millis,
+                )
+            )
+        hedge = None
+        if self.resilience_hedge_millis > 0 or self.resilience_hedge_quantile > 0:
+            hedge = HedgePolicy(
+                delay_ms=self.resilience_hedge_millis,
+                quantile=self.resilience_hedge_quantile,
+            )
+        if (
+            breakers is None
+            and hedge is None
+            and self.resilience_retry_budget <= 0
+            and self.resilience_quorum <= 0
+            and self.resilience_deadline_millis <= 0
+        ):
+            return None
+        return ResiliencePolicy(
+            breakers=breakers,
+            hedge=hedge,
+            retry_budget_tokens=self.resilience_retry_budget,
+            quorum_fraction=self.resilience_quorum,
+            deadline_ms=self.resilience_deadline_millis,
+        )
+
+    def fault_injection_plan(self):
+        """Parsed FAULT_PLAN, or None (chaos runs only)."""
+        if not self.fault_plan:
+            return None
+        from ..resilience import FaultPlan
+
+        return FaultPlan.parse(self.fault_plan)

@@ -14,7 +14,7 @@ from typing import Optional
 from aiohttp import web
 
 from ..errors import ScoreError, StatusError, to_response_error
-from .metrics import Metrics, middleware
+from .metrics import Metrics, middleware, register_resilience
 from ..types.chat_request import ChatCompletionCreateParams as ChatParams
 from ..types.embeddings import CreateEmbeddingParams
 from ..types.multichat_request import (
@@ -96,6 +96,36 @@ def _parse_error_response(e: Exception) -> web.Response:
         text=jsonutil.dumps({"code": 400, "message": message}),
         content_type="application/json",
     )
+
+
+def deadline_middleware(resilience):
+    """Stamp the per-request deadline on the ambient contextvar.
+
+    The client's ``x-deadline-ms`` header wins; the policy's
+    ``deadline_ms`` is the default.  Because aiohttp runs each handler in
+    its own task, the activation is naturally request-scoped and every
+    task the fan-out spawns under it (judge pumps, hedge attempts)
+    inherits the deadline."""
+    from ..resilience import Deadline
+
+    @web.middleware
+    async def _mw(request, handler):
+        ms = resilience.deadline_ms
+        header = request.headers.get("x-deadline-ms")
+        if header:
+            try:
+                ms = float(header)
+            except ValueError:
+                pass
+        if ms <= 0:
+            return await handler(request)
+        token = Deadline(ms / 1000.0).activate()
+        try:
+            return await handler(request)
+        finally:
+            Deadline.deactivate(token)
+
+    return _mw
 
 
 def _make_handler(params_cls, create_streaming, create_unary):
@@ -281,8 +311,11 @@ def build_app(
     batch_max: int = 64,
     reranker=None,
     embed_cache=None,
+    resilience=None,
+    fault_plan=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
+    register_resilience(metrics, resilience, fault_plan)
     if embedder is not None and batcher is None:
         from .batcher import DeviceBatcher
 
@@ -309,7 +342,10 @@ def build_app(
             return stats
 
         metrics.register_provider("score_cache", _score_cache_stats)
-    app = web.Application(middlewares=[middleware(metrics)])
+    middlewares = [middleware(metrics)]
+    if resilience is not None:
+        middlewares.append(deadline_middleware(resilience))
+    app = web.Application(middlewares=middlewares)
     app[METRICS_KEY] = metrics
     if batcher is not None:
         app[BATCHER_KEY] = batcher

@@ -58,6 +58,24 @@ class Metrics:
         return snap
 
 
+def register_resilience(metrics: Metrics, policy, fault_plan=None) -> None:
+    """Surface the resilience subsystem as the ``resilience`` section of
+    ``GET /metrics``: per-upstream breaker states (the breaker-state
+    gauge), retry/hedge/degraded counters, the effective hedge delay, and
+    — on chaos runs — the fault-injection tallies."""
+
+    if policy is None and fault_plan is None:
+        return
+
+    def _snapshot() -> dict:
+        snap = policy.snapshot() if policy is not None else {}
+        if fault_plan is not None:
+            snap["fault_plan"] = fault_plan.snapshot()
+        return snap
+
+    metrics.register_provider("resilience", _snapshot)
+
+
 def _series(request) -> str:
     """Series key = the MATCHED route, so unmatched-path probes can't mint
     unbounded series (they all bucket under ``http:unmatched``)."""

@@ -178,6 +178,10 @@ class ChatCompletionChunk(Struct):
     usage: Optional[Usage] = field(Usage, default=None, merge=NESTED)
     # custom field
     weight_data: object = field(WEIGHT_DATA, default=None)
+    # set (true) on the final aggregate frame when the consensus shipped
+    # without the full panel — weight-quorum early exit or deadline expiry
+    # with a partial panel; absent entirely from healthy responses
+    degraded: Optional[bool] = field(bool, default=None, merge=KEEP)
 
     def tool_as_content(self) -> None:
         for choice in self.choices:
@@ -289,6 +293,7 @@ class ChatCompletion(Struct):
     usage: Optional[Usage] = field(Usage, default=None)
     # custom field
     weight_data: object = field(WEIGHT_DATA, default=None, skip_if_none=False)
+    degraded: Optional[bool] = field(bool, default=None)
 
     @classmethod
     def from_streaming(cls, chunk: ChatCompletionChunk) -> "ChatCompletion":
@@ -300,4 +305,5 @@ class ChatCompletion(Struct):
             object="chat.completion",
             usage=chunk.usage,
             weight_data=chunk.weight_data,
+            degraded=chunk.degraded,
         )

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Chaos gate — the seeded fault-matrix suite (tests marked `chaos`:
+# tests/test_chaos.py), kept OUT of tier-1 on purpose: tier-1 proves the
+# happy paths still hold, this proves the degradation paths (breaker
+# open/recover, hedge races, quorum cancel, per-fault error taxonomy)
+# behave deterministically under injected faults.  Run from the repo
+# root; extra args pass through to pytest.
+set -o pipefail
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+  -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -33,3 +33,14 @@ except ImportError:
     pass
 else:
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 gate"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection suite (scripts/chaos.sh); also "
+        "marked slow so tier-1 (-m 'not slow') never pays for it",
+    )

@@ -173,6 +173,78 @@ def test_no_retry_budget_zero():
     assert len(t.requests) == 1
 
 
+# -- backoff policy -----------------------------------------------------------
+
+
+def test_backoff_jitter_within_randomization_bounds():
+    import random
+
+    policy = BackoffPolicy(
+        initial_interval_ms=100,
+        randomization_factor=0.5,
+        multiplier=2.0,
+        max_interval_ms=400,
+        max_elapsed_ms=None,
+    )
+    gen = policy.sleeps(rng=random.Random(0))
+    expected_intervals = [100, 200, 400, 400, 400, 400]
+    for interval_ms in expected_intervals:
+        sleep_s = next(gen)
+        low = interval_ms * (1 - policy.randomization_factor) / 1000.0
+        high = interval_ms * (1 + policy.randomization_factor) / 1000.0
+        assert low <= sleep_s <= high
+
+
+def test_backoff_interval_capped_at_max():
+    import random
+
+    policy = BackoffPolicy(
+        initial_interval_ms=10,
+        randomization_factor=0.0,
+        multiplier=10.0,
+        max_interval_ms=50,
+        max_elapsed_ms=None,
+    )
+    gen = policy.sleeps(rng=random.Random(1))
+    sleeps = [next(gen) for _ in range(5)]
+    assert sleeps[:2] == [0.01, 0.05]  # 10 -> 100 capped to 50
+    assert all(s == 0.05 for s in sleeps[1:])
+
+
+def test_backoff_deterministic_with_seeded_rng():
+    import random
+
+    policy = BackoffPolicy(max_elapsed_ms=None)
+    a = [next(policy.sleeps(rng=random.Random(7))) for _ in range(1)]
+    g1 = policy.sleeps(rng=random.Random(7))
+    g2 = policy.sleeps(rng=random.Random(7))
+    assert [next(g1) for _ in range(8)] == [next(g2) for _ in range(8)]
+    assert a  # smoke: first draw exists
+
+
+def test_backoff_max_elapsed_terminates():
+    import time as time_mod
+
+    # max_elapsed caps WALL-CLOCK since the first attempt (attempt time
+    # included): once real time passes the cap, the generator stops
+    policy = BackoffPolicy(
+        initial_interval_ms=1,
+        randomization_factor=0.0,
+        multiplier=1.0,
+        max_interval_ms=1,
+        max_elapsed_ms=30,
+    )
+    gen = policy.sleeps()
+    assert next(gen) == 0.001
+    time_mod.sleep(0.05)  # simulate a slow attempt past the 30 ms cap
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_backoff_zero_elapsed_yields_nothing():
+    assert list(BackoffPolicy(max_elapsed_ms=0).sleeps()) == []
+
+
 # -- stream error taxonomy ----------------------------------------------------
 
 

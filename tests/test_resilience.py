@@ -1,0 +1,955 @@
+"""Resilience subsystem (resilience/): circuit breakers, retry budget,
+deadline propagation, hedged judges, weight-quorum degradation, fault
+plans — pure state machines with injected clocks plus client-level
+integration over scripted transports."""
+
+import asyncio
+import random
+import time
+from decimal import Decimal
+
+import pytest
+
+from llm_weighted_consensus_tpu import archive, registry
+from llm_weighted_consensus_tpu.clients.chat import (
+    AiohttpTransport,
+    ApiBase,
+    BackoffPolicy,
+    DefaultChatClient,
+)
+from llm_weighted_consensus_tpu.clients.score import ScoreClient
+from llm_weighted_consensus_tpu.errors import (
+    BreakerOpenError,
+    DeadlineExceededError,
+    StreamTimeoutError,
+    TransportError,
+)
+from llm_weighted_consensus_tpu.identity.model import ModelBase
+from llm_weighted_consensus_tpu.resilience import (
+    BreakerConfig,
+    BreakerRegistry,
+    CircuitBreaker,
+    Deadline,
+    FaultPlan,
+    HedgePolicy,
+    LatencyTracker,
+    QuorumTracker,
+    ResiliencePolicy,
+    RetryBudget,
+    current_deadline,
+    current_retry_budget,
+)
+from llm_weighted_consensus_tpu.resilience.breaker import CLOSED, HALF_OPEN, OPEN
+from llm_weighted_consensus_tpu.types.score_request import (
+    ChatCompletionCreateParams as ScoreParams,
+)
+from llm_weighted_consensus_tpu.types.chat_request import (
+    ChatCompletionCreateParams,
+    UserMessage,
+)
+
+from fakes import FakeTransport, Script, chunk_obj
+
+SEED = 42
+NO_RETRY = BackoffPolicy(max_elapsed_ms=0)
+AB = [
+    ApiBase("https://a.example", "key-a"),
+    ApiBase("https://b.example", "key-b"),
+]
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def fake_clock():
+    t = {"now": 0.0}
+    return t, (lambda: t["now"])
+
+
+# -- circuit breaker state machine -------------------------------------------
+
+
+def test_breaker_opens_at_exact_threshold():
+    t, clock = fake_clock()
+    b = CircuitBreaker(
+        BreakerConfig(threshold=0.5, window=4, min_samples=4), clock=clock
+    )
+    b.record_failure()
+    b.record_success()
+    b.record_success()
+    assert b.state == CLOSED  # 1/3 below threshold, and below min_samples
+    b.record_failure()  # 2 failures of 4 = exactly the 0.5 threshold
+    assert b.state == OPEN
+    assert not b.allow()
+    assert b.opened_total == 1
+
+
+def test_breaker_min_samples_volume_threshold():
+    _, clock = fake_clock()
+    b = CircuitBreaker(
+        BreakerConfig(threshold=0.5, window=20, min_samples=5), clock=clock
+    )
+    for _ in range(4):
+        b.record_failure()  # 100% failure rate but below the volume floor
+    assert b.state == CLOSED
+    b.record_failure()
+    assert b.state == OPEN
+
+
+def test_breaker_half_open_probe_recovers():
+    t, clock = fake_clock()
+    b = CircuitBreaker(
+        BreakerConfig(
+            threshold=1.0, window=2, min_samples=2, cooldown_ms=1000,
+            half_open_probes=1,
+        ),
+        clock=clock,
+    )
+    b.record_failure()
+    b.record_failure()
+    assert b.state == OPEN
+    t["now"] += 0.5
+    assert not b.allow()  # still cooling down
+    t["now"] += 0.6
+    assert b.allow()  # cooldown elapsed -> half-open, probe slot claimed
+    assert b.state == HALF_OPEN
+    assert not b.allow()  # probe cap: one in flight
+    b.record_success()
+    assert b.state == CLOSED
+    assert b.allow()
+    # fresh window after recovery: one failure must not re-trip
+    b.record_failure()
+    assert b.state == CLOSED
+
+
+def test_breaker_half_open_failure_reopens():
+    t, clock = fake_clock()
+    b = CircuitBreaker(
+        BreakerConfig(threshold=1.0, window=2, min_samples=2, cooldown_ms=1000),
+        clock=clock,
+    )
+    b.record_failure()
+    b.record_failure()
+    t["now"] += 1.1
+    assert b.allow()
+    b.record_failure()  # the probe failed
+    assert b.state == OPEN
+    assert b.opened_total == 2
+    assert not b.allow()  # fresh cooldown
+
+
+def test_breaker_registry_keys_and_snapshot():
+    _, clock = fake_clock()
+    reg = BreakerRegistry(BreakerConfig(), clock=clock)
+    b1 = reg.get("https://a.example", "m1")
+    assert reg.get("https://a.example", "m1") is b1
+    assert reg.get("https://a.example", "m2") is not b1
+    snap = reg.snapshot()
+    assert sorted(snap) == ["https://a.example|m1", "https://a.example|m2"]
+    assert snap["https://a.example|m1"]["state"] == "closed"
+
+
+# -- retry budget -------------------------------------------------------------
+
+
+def test_retry_budget_spends_and_denies():
+    budget = RetryBudget(2)
+    assert budget.try_acquire()
+    assert budget.try_acquire()
+    assert not budget.try_acquire()
+    assert budget.spent == 2
+    assert budget.denied == 1
+    assert budget.remaining == 0
+
+
+def test_retry_budget_refill():
+    t, clock = fake_clock()
+    budget = RetryBudget(2, refill_per_sec=1.0, clock=clock)
+    assert budget.try_acquire() and budget.try_acquire()
+    assert not budget.try_acquire()
+    t["now"] += 1.5
+    assert budget.try_acquire()  # 1.5 tokens refilled, capped at capacity
+    assert not budget.try_acquire()
+
+
+def test_retry_budget_contextvar_scope():
+    assert current_retry_budget() is None
+    budget = RetryBudget(1)
+    token = budget.activate()
+    try:
+        assert current_retry_budget() is budget
+    finally:
+        RetryBudget.deactivate(token)
+    assert current_retry_budget() is None
+
+
+# -- deadline -----------------------------------------------------------------
+
+
+def test_deadline_remaining_expired_clamp():
+    t, clock = fake_clock()
+    d = Deadline(1.0, clock=clock)
+    assert d.remaining() == pytest.approx(1.0)
+    assert d.clamp(10.0) == pytest.approx(1.0)
+    assert d.clamp(0.2) == pytest.approx(0.2)
+    assert d.clamp(None) == pytest.approx(1.0)
+    t["now"] += 2.0
+    assert d.expired()
+    assert d.remaining() == 0.0  # never negative
+
+
+def test_deadline_contextvar_scope():
+    assert current_deadline() is None
+    d = Deadline(5.0)
+    token = d.activate()
+    try:
+        assert current_deadline() is d
+    finally:
+        Deadline.deactivate(token)
+    assert current_deadline() is None
+
+
+# -- hedge policy -------------------------------------------------------------
+
+
+def test_latency_tracker_quantile_nearest_rank():
+    tr = LatencyTracker()
+    for v in range(1, 101):
+        tr.record(float(v))
+    assert tr.quantile(0.5) == 50.0
+    assert tr.quantile(0.95) == 95.0
+    assert tr.quantile(1.0) == 100.0
+    assert LatencyTracker().quantile(0.5) is None
+
+
+def test_latency_tracker_ring_overwrite():
+    tr = LatencyTracker(capacity=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+        tr.record(v)
+    assert len(tr) == 4
+    assert tr.total == 6
+    assert tr.quantile(1.0) == 6.0
+    assert tr.quantile(0.0) == 3.0  # 1.0 and 2.0 overwritten
+
+
+def test_hedge_delay_static_until_observed():
+    hedge = HedgePolicy(delay_ms=100.0, quantile=0.9, min_samples=3)
+    assert hedge.enabled
+    assert hedge.delay_ms_effective() == 100.0  # no samples yet
+    hedge.observe(10.0)
+    hedge.observe(20.0)
+    assert hedge.delay_ms_effective() == 100.0  # below min_samples
+    hedge.observe(30.0)
+    assert hedge.delay_ms_effective() == 30.0  # observed p90 takes over
+    assert not HedgePolicy().enabled
+
+
+# -- quorum math --------------------------------------------------------------
+
+
+def quorum_2_1_1():
+    return QuorumTracker(
+        {0: Decimal(2), 1: Decimal(1), 2: Decimal(1)}, 2, 0.5
+    )
+
+
+def test_quorum_waits_for_unflippable_argmax():
+    q = quorum_2_1_1()
+    q.record_vote(0, [Decimal(0), Decimal(1)])
+    # settled 2/4 meets the 0.5 quorum, but remaining weight (2) could
+    # still tie the leader: 2 > 0 + 2 is false -> keep waiting
+    assert not q.decided()
+    q.record_vote(1, [Decimal(0), Decimal(1)])
+    # leader 3 > runner-up 0 + remaining 1 -> the straggler cannot flip it
+    assert q.decided()
+    assert q.pending() == {2}
+
+
+def test_quorum_errored_judge_frees_weight():
+    q = quorum_2_1_1()
+    q.record_vote(0, [Decimal(1), Decimal(0)])
+    q.record_error(1)
+    # settled 3/4, leader 2 > 0 + remaining 1 -> decided
+    assert q.decided()
+    assert q.errored == {1}
+
+
+def test_quorum_idempotent_and_terminal():
+    q = quorum_2_1_1()
+    q.record_vote(0, [Decimal(0), Decimal(1)])
+    q.record_vote(0, [Decimal(0), Decimal(1)])  # duplicate final frame
+    assert q.choice_weight[1] == Decimal(2)
+    q.record_vote(1, [Decimal(0), Decimal(1)])
+    q.record_vote(2, [Decimal(1), Decimal(0)])
+    assert not q.decided()  # full panel settled: nothing left to cancel
+    assert q.pending() == set()
+
+
+def test_quorum_disabled_fraction():
+    q = QuorumTracker({0: Decimal(1), 1: Decimal(1)}, 2, 0.0)
+    q.record_vote(0, [Decimal(0), Decimal(1)])
+    assert not q.decided()
+
+
+# -- fault plan ---------------------------------------------------------------
+
+
+def test_fault_plan_same_seed_same_sequence():
+    probs = {"connect": 0.15, "5xx": 0.15, "stall_first": 0.2}
+    a = FaultPlan(seed=42, probabilities=probs)
+    b = FaultPlan(seed=42, probabilities=probs)
+    seq_a = [a.next_fault() for _ in range(64)]
+    seq_b = [b.next_fault() for _ in range(64)]
+    assert seq_a == seq_b
+    assert a.injected == b.injected
+    assert len({k for k in seq_a if k}) >= 2  # the mix actually fires
+    assert FaultPlan(seed=7, probabilities=probs).rng.random() != FaultPlan(
+        seed=8, probabilities=probs
+    ).rng.random()
+
+
+def test_fault_plan_scripted_and_exhaustion():
+    plan = FaultPlan.scripted(["connect", None, "5xx"])
+    assert plan.next_fault() == "connect"
+    assert plan.next_fault() is None
+    assert plan.next_fault() == "5xx"
+    assert plan.next_fault() is None  # healthy after exhaustion
+    assert plan.snapshot() == {
+        "requests": 4,
+        "injected": {"connect": 1, "5xx": 1},
+    }
+
+
+def test_fault_plan_parse():
+    plan = FaultPlan.parse("seed=7,stall_ms=250,connect=0.25,5xx=0.1")
+    assert plan.seed == 7
+    assert plan.stall_ms == 250.0
+    assert plan.probabilities["connect"] == 0.25
+    assert plan.probabilities["5xx"] == 0.1
+    scripted = FaultPlan.parse("script=connect|ok|truncate")
+    assert [scripted.next_fault() for _ in range(3)] == [
+        "connect", None, "truncate",
+    ]
+    with pytest.raises(ValueError):
+        FaultPlan.parse("bogus_kind=0.5")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("script=not_a_fault")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("justakey")
+
+
+# -- chat client integration: breaker gate ------------------------------------
+
+
+def chat_params():
+    return ChatCompletionCreateParams(
+        messages=[UserMessage(content="hi")], model="fake-model"
+    )
+
+
+async def _stream_items(c, p=None):
+    stream = await c.create_streaming(None, p or chat_params())
+    return [item async for item in stream]
+
+
+def test_breaker_rejects_then_recovers_through_client():
+    t, clock = fake_clock()
+    policy = ResiliencePolicy(
+        breakers=BreakerRegistry(
+            BreakerConfig(
+                threshold=1.0, window=2, min_samples=2, cooldown_ms=5000
+            ),
+            clock=clock,
+        )
+    )
+    transport = FakeTransport(
+        [
+            Script(connect_error=TransportError("refused")),
+            Script(connect_error=TransportError("refused")),
+            Script([chunk_obj("recovered")]),
+        ]
+    )
+    c = DefaultChatClient(
+        transport, AB[:1], backoff=NO_RETRY, resilience=policy
+    )
+    for _ in range(2):
+        with pytest.raises(TransportError):
+            go(_stream_items(c))
+    # breaker is now open: the next call is refused LOCALLY -- the script
+    # for the recovery probe must still be unconsumed
+    with pytest.raises(BreakerOpenError):
+        go(_stream_items(c))
+    assert len(transport.requests) == 2
+    assert policy.counters["breaker_rejected"] == 1
+    snap = policy.snapshot()
+    assert snap["breakers"]["https://a.example|fake-model"]["state"] == "open"
+    # cooldown elapses -> the half-open probe goes through and closes it
+    t["now"] += 6.0
+    items = go(_stream_items(c))
+    assert items[0].choices[0].delta.content == "recovered"
+    assert (
+        policy.snapshot()["breakers"]["https://a.example|fake-model"]["state"]
+        == "closed"
+    )
+
+
+def test_breaker_ignores_client_errors_and_deadline():
+    from llm_weighted_consensus_tpu.clients.chat import _breaker_failure
+    from llm_weighted_consensus_tpu.errors import BadStatusError
+
+    assert _breaker_failure(TransportError("x"))
+    assert _breaker_failure(StreamTimeoutError())
+    assert _breaker_failure(BadStatusError(503, "busy"))
+    assert _breaker_failure(BadStatusError(429, "rate"))
+    assert not _breaker_failure(BadStatusError(404, "missing"))
+    assert not _breaker_failure(DeadlineExceededError())
+
+
+def test_retry_budget_stops_backoff_loop():
+    # generous backoff but a dry shared budget: exactly one retry happens
+    budget = RetryBudget(1)
+    transport = FakeTransport(
+        [Script(connect_error=TransportError("refused")) for _ in range(8)]
+    )
+    c = DefaultChatClient(
+        transport,
+        AB[:1],
+        backoff=BackoffPolicy(
+            initial_interval_ms=1, max_interval_ms=1, max_elapsed_ms=60000
+        ),
+        resilience=ResiliencePolicy(),
+    )
+
+    async def run():
+        token = budget.activate()
+        try:
+            return await _stream_items(c)
+        finally:
+            RetryBudget.deactivate(token)
+
+    with pytest.raises(TransportError):
+        go(run())
+    assert len(transport.requests) == 2  # initial pass + the 1 budgeted retry
+    assert budget.denied == 1
+
+
+# -- score client integration: hedge, quorum, deadline ------------------------
+
+
+TEXTS = ["answer alpha", "answer beta", "answer gamma"]
+
+
+def make_model(judges):
+    return ModelBase.from_json_obj({"llms": judges}).into_model_validate()
+
+
+def inline_model_json(model):
+    return {"llms": [llm.base.to_json_obj() for llm in model.llms]}
+
+
+def ballot_keys(n, top_logprobs=None):
+    from llm_weighted_consensus_tpu.ballot import PrefixTree, branch_limit
+
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, n, branch_limit(top_logprobs))
+    return {idx: key for key, idx in tree.key_indices(rng)}
+
+
+def judge_script(key, **kw):
+    return Script(
+        [
+            chunk_obj("I pick ", model="up-model"),
+            chunk_obj(f"{key} as best.", model="up-model", finish="stop"),
+        ],
+        **kw,
+    )
+
+
+def score_params(choices, model, **kw):
+    return ScoreParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "pick the best"}],
+            "model": model,
+            "choices": choices,
+            **kw,
+        }
+    )
+
+
+def scripts_by_model(model, by_model):
+    """Scripts in fan-out order (llm order, not declaration order)."""
+    return [by_model[llm.base.model] for llm in model.llms]
+
+
+def make_score_client(scripts, policy, api_bases=None, **kw):
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport,
+        api_bases or AB[:1],
+        backoff=NO_RETRY,
+        resilience=policy,
+    )
+    client = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+        resilience=policy,
+        **kw,
+    )
+    return client, transport
+
+
+async def collect(client, params):
+    stream = await client.create_streaming(None, params)
+    return [item async for item in stream]
+
+
+def test_hedge_backup_wins_vote_tallied_once():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(hedge=HedgePolicy(delay_ms=30.0))
+    model = make_model([{"model": "judge-a", "weight": {"type": "static", "weight": 1}}])
+    # primary attempt stalls well past the hedge delay; the backup (next
+    # api base) answers immediately and wins the race
+    client, transport = make_score_client(
+        [judge_script(keys[1], delays={0: 1.0}), judge_script(keys[1])],
+        policy,
+        api_bases=AB,
+    )
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert len(transport.requests) == 2  # primary + one hedged backup
+    assert transport.requests[1][0] == "https://b.example/chat/completions"
+    assert policy.counters["hedge_launched"] == 1
+    assert policy.counters["hedge_won"] == 1
+
+    final = items[-1]
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    # exactly one vote's worth of weight: the loser's stream was discarded
+    assert cand[1].weight == Decimal(1)
+    assert cand[1].confidence == Decimal(1)
+    assert cand[0].weight == cand[2].weight == Decimal(0)
+    votes = [
+        c.delta.vote
+        for chunk in items[1:-1]
+        for c in chunk.choices
+        if c.delta.vote is not None
+    ]
+    assert len(votes) == 1
+    assert "degraded" not in final.to_json_obj()
+
+
+def test_hedge_not_launched_when_primary_fast():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(hedge=HedgePolicy(delay_ms=30000.0))
+    model = make_model([{"model": "judge-a", "weight": {"type": "static", "weight": 1}}])
+    client, transport = make_score_client(
+        [judge_script(keys[0])], policy, api_bases=AB
+    )
+    go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert len(transport.requests) == 1
+    assert "hedge_launched" not in policy.counters
+    assert len(policy.hedge.tracker) == 1  # committed latency observed
+
+
+def three_judge_model():
+    return make_model(
+        [
+            {"model": "judge-a", "weight": {"type": "static", "weight": 2}},
+            {"model": "judge-b", "weight": {"type": "static", "weight": 1}},
+            {"model": "judge-c", "weight": {"type": "static", "weight": 1}},
+        ]
+    )
+
+
+def test_quorum_degrades_and_cancels_straggler():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(quorum_fraction=0.5)
+    model = three_judge_model()
+    # judges a (w=2) and b (w=1) agree fast; judge c stalls "forever" --
+    # after b settles the leader is unflippable (3 > 0 + 1) and c is cut
+    client, transport = make_score_client(
+        scripts_by_model(
+            model,
+            {
+                "judge-a": judge_script(keys[1]),
+                "judge-b": judge_script(keys[1]),
+                "judge-c": judge_script(keys[1], delays={0: 30.0}),
+            },
+        ),
+        policy,
+    )
+    t0 = time.monotonic()
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert time.monotonic() - t0 < 5.0  # the 30 s straggler was cancelled
+    assert policy.counters["quorum_degraded"] == 1
+
+    final = items[-1]
+    assert final.degraded is True
+    assert final.to_json_obj()["degraded"] is True
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    # tally over the settled panel only, renormalized: 3 of 3 weight
+    assert cand[1].weight == Decimal(3)
+    assert cand[1].confidence == Decimal(1)
+    assert cand[0].weight == cand[2].weight == Decimal(0)
+    # per-judge failure detail survives on the degraded final frame
+    judge = {c.model_index: c for c in final.choices if c.index >= 3}
+    c_index = next(l.index for l in model.llms if l.base.model == "judge-c")
+    straggler = judge[c_index]
+    assert straggler.error is not None
+    assert straggler.error.code == 499
+    assert "straggler cancelled" in straggler.error.message
+    assert straggler.weight == Decimal(1)
+    for judge_index, choice in judge.items():
+        if judge_index != c_index:
+            assert choice.error is None
+            assert choice.confidence == Decimal(1)
+        assert choice.delta.vote is None  # votes still cleared on the final
+
+
+def test_quorum_waits_when_argmax_flippable():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(quorum_fraction=0.5)
+    model = three_judge_model()
+    # a and b DISAGREE: after both settle, leader 2 vs runner-up 1 with
+    # weight 1 pending -> 2 > 1 + 1 is false, so c must be awaited
+    client, transport = make_score_client(
+        scripts_by_model(
+            model,
+            {
+                "judge-a": judge_script(keys[0]),
+                "judge-b": judge_script(keys[2]),
+                "judge-c": judge_script(keys[2], delays={0: 0.05}),
+            },
+        ),
+        policy,
+    )
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    final = items[-1]
+    assert "quorum_degraded" not in policy.counters
+    assert "degraded" not in final.to_json_obj()
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    assert cand[0].weight == Decimal(2)
+    assert cand[2].weight == Decimal(2)  # b + c both landed
+
+
+def test_deadline_partial_panel_degrades():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy()
+    model = make_model(
+        [
+            {"model": "judge-a", "weight": {"type": "static", "weight": 1}},
+            {"model": "judge-b", "weight": {"type": "static", "weight": 1}},
+        ]
+    )
+    client, transport = make_score_client(
+        scripts_by_model(
+            model,
+            {
+                "judge-a": judge_script(keys[1]),
+                "judge-b": judge_script(keys[1], delays={0: 30.0}),
+            },
+        ),
+        policy,
+    )
+
+    async def run():
+        token = Deadline(0.2).activate()
+        try:
+            return await collect(
+                client, score_params(TEXTS, inline_model_json(model))
+            )
+        finally:
+            Deadline.deactivate(token)
+
+    t0 = time.monotonic()
+    items = go(run())
+    assert time.monotonic() - t0 < 5.0
+    assert policy.counters["deadline_degraded"] == 1
+    final = items[-1]
+    assert final.degraded is True
+    judge = {c.model_index: c for c in final.choices if c.index >= 3}
+    b_index = next(l.index for l in model.llms if l.base.model == "judge-b")
+    assert judge[b_index].error is not None
+    assert judge[b_index].error.code == 504  # deadline_exceeded taxonomy
+    a_index = next(l.index for l in model.llms if l.base.model == "judge-a")
+    assert judge[a_index].error is None
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    assert cand[1].weight == Decimal(1)
+    assert cand[1].confidence == Decimal(1)
+
+
+def test_resilience_unset_keeps_wire_format():
+    # the None-policy default: healthy responses carry no degraded field
+    # and judge errors are still cleared from the final frame
+    keys = ballot_keys(3)
+    model = make_model(
+        [{"model": "judge-a", "weight": {"type": "static", "weight": 1}}]
+    )
+    client, _ = make_score_client([judge_script(keys[0])], None)
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    for item in items:
+        assert "degraded" not in item.to_json_obj()
+
+
+# -- deadline middleware ------------------------------------------------------
+
+
+class _FakeRequest:
+    def __init__(self, headers=None):
+        self.headers = headers or {}
+
+
+def test_deadline_middleware_header_overrides_default():
+    from llm_weighted_consensus_tpu.serve.gateway import deadline_middleware
+
+    mw = deadline_middleware(ResiliencePolicy(deadline_ms=60000.0))
+
+    async def handler(request):
+        return current_deadline()
+
+    d = go(mw(_FakeRequest({"x-deadline-ms": "250"}), handler))
+    assert d is not None
+    assert d.remaining() <= 0.25
+    # default applies without the header
+    d = go(mw(_FakeRequest(), handler))
+    assert 50.0 < d.remaining() <= 60.0
+    # deadline does not leak past the request scope
+    assert current_deadline() is None
+
+
+def test_deadline_middleware_disabled_and_bad_header():
+    from llm_weighted_consensus_tpu.serve.gateway import deadline_middleware
+
+    mw = deadline_middleware(ResiliencePolicy(deadline_ms=0.0))
+
+    async def handler(request):
+        return current_deadline()
+
+    assert go(mw(_FakeRequest(), handler)) is None
+    assert go(mw(_FakeRequest({"x-deadline-ms": "nope"}), handler)) is None
+
+
+# -- serving config -----------------------------------------------------------
+
+
+def test_config_resilience_defaults_off():
+    from llm_weighted_consensus_tpu.serve.config import Config
+
+    config = Config.from_env({})
+    assert config.resilience_policy() is None
+    assert config.fault_injection_plan() is None
+    assert config.connect_timeout_millis == 30000.0
+
+
+def test_config_resilience_knobs():
+    from llm_weighted_consensus_tpu.serve.config import Config
+
+    config = Config.from_env(
+        {
+            "CONNECT_TIMEOUT_MILLIS": "1234",
+            "RESILIENCE_BREAKER_THRESHOLD": "0.4",
+            "RESILIENCE_BREAKER_WINDOW": "10",
+            "RESILIENCE_BREAKER_MIN_SAMPLES": "3",
+            "RESILIENCE_BREAKER_COOLDOWN_MILLIS": "2500",
+            "RESILIENCE_RETRY_BUDGET": "6",
+            "RESILIENCE_HEDGE_MILLIS": "80",
+            "RESILIENCE_HEDGE_QUANTILE": "0.95",
+            "RESILIENCE_DEADLINE_MILLIS": "4000",
+            "RESILIENCE_QUORUM": "0.6",
+            "FAULT_PLAN": "seed=5,connect=0.2",
+        }
+    )
+    assert config.connect_timeout_millis == 1234.0
+    policy = config.resilience_policy()
+    assert policy.breakers is not None
+    assert policy.breakers.config.threshold == 0.4
+    assert policy.breakers.config.window == 10
+    assert policy.breakers.config.min_samples == 3
+    assert policy.breakers.config.cooldown_ms == 2500.0
+    assert policy.hedge.delay_ms == 80.0
+    assert policy.hedge.quantile == 0.95
+    assert policy.retry_budget_tokens == 6
+    assert policy.deadline_ms == 4000.0
+    assert policy.quorum_fraction == 0.6
+    plan = config.fault_injection_plan()
+    assert plan.seed == 5
+    assert plan.probabilities["connect"] == 0.2
+
+
+def test_config_resilience_validation():
+    from llm_weighted_consensus_tpu.serve.config import Config
+
+    with pytest.raises(ValueError):
+        Config.from_env({"RESILIENCE_QUORUM": "1.5"})
+    with pytest.raises(ValueError):
+        Config.from_env({"RESILIENCE_HEDGE_QUANTILE": "1.0"})
+
+
+def test_connect_timeout_reaches_session():
+    async def run():
+        transport = AiohttpTransport(connect_timeout_ms=1234.0)
+        session = transport._get_session()
+        try:
+            return session.timeout.sock_connect
+        finally:
+            await session.close()
+
+    assert go(run()) == pytest.approx(1.234)
+
+
+def test_metrics_resilience_provider():
+    from llm_weighted_consensus_tpu.serve.metrics import (
+        Metrics,
+        register_resilience,
+    )
+
+    policy = ResiliencePolicy(
+        breakers=BreakerRegistry(BreakerConfig()),
+        hedge=HedgePolicy(delay_ms=50.0),
+    )
+    policy.inc("hedge_launched")
+    plan = FaultPlan.scripted(["connect"])
+    plan.next_fault()
+    metrics = Metrics()
+    register_resilience(metrics, policy, plan)
+    snap = metrics.snapshot()["resilience"]
+    assert snap["counters"] == {"hedge_launched": 1}
+    assert snap["breakers"] == {}
+    assert snap["hedge_delay_ms"] == 50.0
+    assert snap["fault_plan"] == {"requests": 1, "injected": {"connect": 1}}
+    # nothing configured -> no section at all
+    bare = Metrics()
+    register_resilience(bare, None, None)
+    assert "resilience" not in bare.snapshot()
+
+
+# -- stream timeout tiers (errors.py satellite) -------------------------------
+
+
+def test_stream_timeout_error_tiers():
+    legacy = StreamTimeoutError()
+    assert str(legacy).endswith("error fetching stream: timeout")
+    assert legacy.tier is None and legacy.elapsed_ms is None
+    tiered = StreamTimeoutError("first_chunk", 123.4)
+    assert tiered.tier == "first_chunk"
+    assert tiered.elapsed_ms == 123.4
+    assert "first_chunk timeout after 123ms" in str(tiered)
+
+
+def test_stream_timeout_tier_through_client():
+    transport = FakeTransport([Script([chunk_obj("late")], delays={0: 0.2})])
+    c = DefaultChatClient(
+        transport, AB[:1], backoff=NO_RETRY, first_chunk_timeout_ms=20
+    )
+    with pytest.raises(StreamTimeoutError) as ei:
+        go(_stream_items(c))
+    assert ei.value.tier == "first_chunk"
+    assert ei.value.elapsed_ms >= 20.0
+
+    transport = FakeTransport(
+        [Script([chunk_obj("a"), chunk_obj("slow")], delays={1: 0.2})]
+    )
+    c = DefaultChatClient(
+        transport,
+        AB[:1],
+        backoff=NO_RETRY,
+        first_chunk_timeout_ms=5000,
+        other_chunk_timeout_ms=20,
+    )
+    items = go(_stream_items(c))
+    assert isinstance(items[-1], StreamTimeoutError)
+    assert items[-1].tier == "other_chunk"
+
+
+# -- cache admission (degraded never cached) ----------------------------------
+
+
+def _chunk(degraded=None, error=False):
+    from llm_weighted_consensus_tpu.types.score_response import (
+        ChatCompletionChunk,
+    )
+
+    obj = {
+        "id": "scrcpl-x",
+        "object": "chat.completion.chunk",
+        "created": 1,
+        "model": "m",
+        "choices": [],
+    }
+    chunk = ChatCompletionChunk.from_json_obj(obj)
+    if degraded is not None:
+        chunk.degraded = degraded
+    if error:
+        from llm_weighted_consensus_tpu.types.score_response import (
+            ResponseError,
+            StreamingChoice,
+        )
+        from llm_weighted_consensus_tpu.types.chat_response import Delta
+
+        chunk.choices = [
+            StreamingChoice(
+                delta=Delta(),
+                finish_reason="error",
+                index=3,
+                logprobs=None,
+                error=ResponseError(code=499, message="cancelled"),
+            )
+        ]
+    return chunk
+
+
+def test_record_stream_skips_degraded():
+    from llm_weighted_consensus_tpu.cache.replay import record_stream
+
+    async def consume(chunks):
+        stored = []
+
+        async def gen():
+            for chunk in chunks:
+                yield chunk
+
+        async for _ in record_stream(gen(), stored.append):
+            pass
+        return stored
+
+    # healthy stream records
+    assert len(go(consume([_chunk(), _chunk()]))) == 1
+    # a degraded final frame poisons the record
+    assert go(consume([_chunk(), _chunk(degraded=True)])) == []
+    # so does a per-judge error choice
+    assert go(consume([_chunk(error=True), _chunk()])) == []
+
+
+def test_quorum_degraded_result_not_cached_end_to_end():
+    from llm_weighted_consensus_tpu.cache import ScoreCache
+
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(quorum_fraction=0.5)
+    model = three_judge_model()
+    one_round = scripts_by_model(
+        model,
+        {
+            "judge-a": judge_script(keys[1]),
+            "judge-b": judge_script(keys[1]),
+            "judge-c": judge_script(keys[1], delays={0: 30.0}),
+        },
+    )
+    second_round = scripts_by_model(
+        model,
+        {
+            "judge-a": judge_script(keys[1]),
+            "judge-b": judge_script(keys[1]),
+            "judge-c": judge_script(keys[1], delays={0: 30.0}),
+        },
+    )
+    client, transport = make_score_client(
+        one_round + second_round, policy, cache=ScoreCache(600.0, 1 << 20)
+    )
+    first = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert first[-1].degraded is True
+    # identical request again: a cached (degraded) entry would be replayed
+    # without touching the transport -- all six scripts must be consumed
+    second = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert second[-1].degraded is True
+    assert len(transport.requests) == 6

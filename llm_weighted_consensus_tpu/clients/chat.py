@@ -357,43 +357,63 @@ class DefaultChatClient(ChatClient):
         hedge = policy.hedge if policy is not None else None
         if hedge is None or not hedge.enabled or len(attempts) < 2:
             return await self._open_committed(attempts[i], request)
+        delay_ms = hedge.delay_ms_effective()
+        if delay_ms is None:
+            # quantile-only config, reservoir still cold: no hedge yet
+            return await self._open_committed(attempts[i], request)
 
         primary = asyncio.create_task(self._open_committed(attempts[i], request))
-        delay = hedge.delay_ms_effective() / 1000.0
-        deadline = current_deadline()
-        if deadline is not None:
-            delay = min(delay, deadline.remaining())
-        done, _ = await asyncio.wait({primary}, timeout=delay)
-        if primary in done:
-            return primary.result()
+        backup = None
+        try:
+            delay = delay_ms / 1000.0
+            deadline = current_deadline()
+            if deadline is not None:
+                delay = min(delay, deadline.remaining())
+            done, _ = await asyncio.wait({primary}, timeout=delay)
+            if primary in done:
+                return primary.result()
 
-        self._inc("hedge_launched")
-        backup = asyncio.create_task(
-            self._open_committed(attempts[(i + 1) % len(attempts)], request)
-        )
-        tasks = {primary, backup}
-        last: Optional[ChatError] = None
-        while tasks:
-            done, tasks = await asyncio.wait(
-                tasks, return_when=asyncio.FIRST_COMPLETED
+            # a hedge is an extra attempt, so it spends the shared retry
+            # budget: under a brown-out (exactly when hedge delays fire) a
+            # dry budget disables hedging before it can double the load
+            budget = current_retry_budget()
+            if budget is not None and not budget.try_acquire():
+                self._inc("hedge_denied")
+                return await primary
+
+            self._inc("hedge_launched")
+            backup = asyncio.create_task(
+                self._open_committed(attempts[(i + 1) % len(attempts)], request)
             )
-            winner = None
-            for task in done:
-                result = task.result()
-                if isinstance(result, ChatError):
-                    last = result
-                elif winner is None:
-                    winner = (task, result)
-                else:
-                    # both committed in one wake-up: keep the first, close
-                    # the duplicate stream
-                    await _close_committed(result)
-            if winner is not None:
-                if winner[0] is backup:
-                    self._inc("hedge_won")
-                await _discard_attempts(tasks)
-                return winner[1]
-        return last
+            tasks = {primary, backup}
+            last: Optional[ChatError] = None
+            while tasks:
+                done, tasks = await asyncio.wait(
+                    tasks, return_when=asyncio.FIRST_COMPLETED
+                )
+                winner = None
+                for task in done:
+                    result = task.result()
+                    if isinstance(result, ChatError):
+                        last = result
+                    elif winner is None:
+                        winner = (task, result)
+                    else:
+                        # both committed in one wake-up: keep the first, close
+                        # the duplicate stream
+                        await _close_committed(result)
+                if winner is not None:
+                    if winner[0] is backup:
+                        self._inc("hedge_won")
+                    await _discard_attempts(tasks)
+                    return winner[1]
+            return last
+        except BaseException:
+            # cancellation (quorum early-exit, client disconnect) or an
+            # unexpected task exception must not orphan the sibling attempt
+            # or any committed upstream stream it holds
+            await _discard_attempts({t for t in (primary, backup) if t is not None})
+            raise
 
     async def _open_committed(self, attempt, request):
         """One attempt end to end: breaker gate, open, first-chunk peek.
@@ -408,30 +428,45 @@ class DefaultChatClient(ChatClient):
             if not breaker.allow():
                 self._inc("breaker_rejected")
                 return BreakerOpenError(attempt.api_base.api_base, attempt.model)
-        # per-attempt clone: hedged attempts run concurrently and must not
-        # race on the shared request's model field
-        req = request.clone()
-        req.model = attempt.model
-        start = time.monotonic()
-        stream = self._open_event_stream(attempt.api_base, req)
-        # first-chunk peek: commit only on a good first chunk
+        # allow() may have claimed a half-open probe slot; from here on
+        # every exit must settle it — record an outcome, or release it when
+        # the attempt is cancelled / ends without a verdict
+        resolved = breaker is None
         try:
-            first = await stream.__anext__()
-        except StopAsyncIteration:
-            first = EmptyStreamError()
-        if isinstance(first, ChatError):
-            await stream.aclose()
+            # per-attempt clone: hedged attempts run concurrently and must not
+            # race on the shared request's model field
+            req = request.clone()
+            req.model = attempt.model
+            start = time.monotonic()
+            stream = self._open_event_stream(attempt.api_base, req)
+            # first-chunk peek: commit only on a good first chunk
+            try:
+                first = await stream.__anext__()
+            except StopAsyncIteration:
+                first = EmptyStreamError()
+            if isinstance(first, ChatError):
+                if breaker is not None:
+                    if _breaker_failure(first):
+                        breaker.record_failure()
+                    elif isinstance(first, DeadlineExceededError):
+                        # our budget ran out before the upstream answered:
+                        # neither success nor failure — the upstream's
+                        # health was never actually probed
+                        breaker.release_probe()
+                    else:
+                        breaker.record_success()
+                    resolved = True
+                await stream.aclose()
+                return first
             if breaker is not None:
-                if _breaker_failure(first):
-                    breaker.record_failure()
-                else:
-                    breaker.record_success()
-            return first
-        if breaker is not None:
-            breaker.record_success()
-        if policy is not None and policy.hedge is not None:
-            policy.hedge.observe((time.monotonic() - start) * 1000.0)
-        return _prepend(first, stream), attempt.api_base
+                breaker.record_success()
+                resolved = True
+            if policy is not None and policy.hedge is not None:
+                policy.hedge.observe((time.monotonic() - start) * 1000.0)
+            return _prepend(first, stream), attempt.api_base
+        finally:
+            if not resolved:
+                breaker.release_probe()
 
     # -- stream machinery ---------------------------------------------------
 
@@ -470,6 +505,7 @@ class DefaultChatClient(ChatClient):
             yield TransportError(str(e))
             return
 
+        byte_iter = None
         try:
             if not (200 <= resp.status < 300):
                 started = time.monotonic()
@@ -534,6 +570,11 @@ class DefaultChatClient(ChatClient):
                 item = self._decode_chunk(event)
                 yield item
         finally:
+            # a [DONE] frame exits before the byte stream is exhausted:
+            # close it rather than leave a suspended generator to the GC
+            aclose = getattr(byte_iter, "aclose", None)
+            if aclose is not None:
+                await aclose()
             await resp.close()
 
     @staticmethod

@@ -77,7 +77,9 @@ class ResiliencePolicy:
         if self.breakers is not None:
             out["breakers"] = self.breakers.snapshot()
         if self.hedge is not None and self.hedge.tracker is not None:
-            out["hedge_delay_ms"] = round(self.hedge.delay_ms_effective(), 2)
+            delay = self.hedge.delay_ms_effective()
+            # None = quantile config still cold, hedging suppressed
+            out["hedge_delay_ms"] = None if delay is None else round(delay, 2)
         return out
 
 

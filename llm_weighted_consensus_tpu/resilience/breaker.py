@@ -12,7 +12,9 @@ attempt matrix iterates — with the classic three states:
   transitions to half-open.
 * HALF_OPEN  — a bounded number of probe requests (``half_open_probes``)
   is let through; one success closes the breaker (window reset), one
-  failure re-opens it for a fresh cooldown.
+  failure re-opens it for a fresh cooldown.  A probe whose attempt is
+  cancelled or ends without a verdict must hand its slot back via
+  ``release_probe()`` so the breaker can probe again.
 
 The clock is injectable so the state machine is testable without
 sleeping; nothing here is async — callers sequence ``allow`` /
@@ -98,6 +100,15 @@ class CircuitBreaker:
         self._outcomes.append(False)
         if self.state == CLOSED and self._failure_rate_trips():
             self._trip()
+
+    def release_probe(self) -> None:
+        """Return a probe slot claimed by ``allow()`` without recording an
+        outcome — the attempt was cancelled (hedge loser, quorum early-exit,
+        client disconnect) or ended neutrally (our deadline expired before
+        the upstream answered).  Without this, a cancelled half-open probe
+        would strand its slot and the breaker would reject forever."""
+        if self.state == HALF_OPEN and self._probes_in_flight > 0:
+            self._probes_in_flight -= 1
 
     def _failure_rate_trips(self) -> bool:
         cfg = self.config

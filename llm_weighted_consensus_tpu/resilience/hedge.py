@@ -73,11 +73,18 @@ class HedgePolicy:
     def enabled(self) -> bool:
         return self.delay_ms > 0 or self.quantile > 0
 
-    def delay_ms_effective(self) -> float:
-        if self.quantile > 0 and len(self.tracker) >= max(1, self.min_samples):
-            observed = self.tracker.quantile(self.quantile)
-            if observed is not None:
-                return observed
+    def delay_ms_effective(self) -> Optional[float]:
+        """Delay before the backup launches, or ``None`` when hedging is
+        off for this attempt: a quantile-only config whose reservoir is
+        still cold must not fall back to 0 ms — that would hedge *every*
+        request (2x upstream load) until the tracker warms up."""
+        if self.quantile > 0:
+            if len(self.tracker) >= max(1, self.min_samples):
+                observed = self.tracker.quantile(self.quantile)
+                if observed is not None:
+                    return observed
+            if self.delay_ms <= 0:
+                return None
         return self.delay_ms
 
     def observe(self, first_chunk_latency_ms: float) -> None:

@@ -139,6 +139,29 @@ def test_breaker_half_open_failure_reopens():
     assert not b.allow()  # fresh cooldown
 
 
+def test_breaker_release_probe_frees_half_open_slot():
+    t, clock = fake_clock()
+    b = CircuitBreaker(
+        BreakerConfig(
+            threshold=1.0, window=2, min_samples=2, cooldown_ms=1000,
+            half_open_probes=1,
+        ),
+        clock=clock,
+    )
+    b.record_failure()
+    b.record_failure()
+    t["now"] += 1.1
+    assert b.allow()  # half-open, the one probe slot claimed
+    assert not b.allow()
+    b.release_probe()  # probe cancelled mid-flight: slot back, no outcome
+    assert b.state == HALF_OPEN
+    assert b.allow()  # a fresh probe can go through -- breaker not wedged
+    b.record_success()
+    assert b.state == CLOSED
+    b.release_probe()  # no-op outside half-open
+    assert b.state == CLOSED and b.allow()
+
+
 def test_breaker_registry_keys_and_snapshot():
     _, clock = fake_clock()
     reg = BreakerRegistry(BreakerConfig(), clock=clock)
@@ -243,6 +266,22 @@ def test_hedge_delay_static_until_observed():
     hedge.observe(30.0)
     assert hedge.delay_ms_effective() == 30.0  # observed p90 takes over
     assert not HedgePolicy().enabled
+
+
+def test_hedge_quantile_only_suppressed_until_warm():
+    # no static floor: a cold reservoir must suppress hedging entirely,
+    # not fall back to 0 ms and hedge every request after a restart
+    hedge = HedgePolicy(quantile=0.9, min_samples=3)
+    assert hedge.enabled
+    assert hedge.delay_ms_effective() is None
+    hedge.observe(10.0)
+    hedge.observe(20.0)
+    assert hedge.delay_ms_effective() is None
+    snap = ResiliencePolicy(hedge=hedge).snapshot()
+    assert snap["hedge_delay_ms"] is None
+    hedge.observe(30.0)
+    assert hedge.delay_ms_effective() == 30.0
+    assert ResiliencePolicy(hedge=hedge).snapshot()["hedge_delay_ms"] == 30.0
 
 
 # -- quorum math --------------------------------------------------------------
@@ -406,6 +445,91 @@ def test_breaker_ignores_client_errors_and_deadline():
     assert not _breaker_failure(DeadlineExceededError())
 
 
+def half_open_breaker_policy(clock):
+    """A policy whose (single-slot) breaker for AB[0] is two failures from
+    open; tests trip it, advance the clock past cooldown, and exercise the
+    half-open probe paths."""
+    return ResiliencePolicy(
+        breakers=BreakerRegistry(
+            BreakerConfig(
+                threshold=1.0, window=2, min_samples=2, cooldown_ms=1000,
+                half_open_probes=1,
+            ),
+            clock=clock,
+        )
+    )
+
+
+def test_cancelled_half_open_probe_releases_slot():
+    t, clock = fake_clock()
+    policy = half_open_breaker_policy(clock)
+    transport = FakeTransport(
+        [
+            Script(connect_error=TransportError("refused")),
+            Script(connect_error=TransportError("refused")),
+            Script([chunk_obj("probe stalls")], delays={0: 30.0}),
+            Script([chunk_obj("recovered")]),
+        ]
+    )
+    c = DefaultChatClient(transport, AB[:1], backoff=NO_RETRY, resilience=policy)
+    for _ in range(2):
+        with pytest.raises(TransportError):
+            go(_stream_items(c))
+    t["now"] += 1.1  # cooldown elapsed: the next attempt IS the probe
+
+    async def run():
+        # the probe stalls and the caller gives up (quorum early-exit /
+        # client disconnect): cancellation must hand the slot back
+        task = asyncio.ensure_future(_stream_items(c))
+        await asyncio.sleep(0.05)
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        # breaker not wedged: the next attempt probes and closes it
+        return await _stream_items(c)
+
+    items = go(run())
+    assert items[0].choices[0].delta.content == "recovered"
+    breaker = policy.breakers.get("https://a.example", "fake-model")
+    assert breaker.state == CLOSED
+
+
+def test_deadline_expiry_neutral_for_half_open_breaker():
+    t, clock = fake_clock()
+    policy = half_open_breaker_policy(clock)
+    transport = FakeTransport(
+        [
+            Script(connect_error=TransportError("refused")),
+            Script(connect_error=TransportError("refused")),
+            Script([chunk_obj("too late")], delays={0: 30.0}),
+            Script([chunk_obj("real probe")]),
+        ]
+    )
+    c = DefaultChatClient(transport, AB[:1], backoff=NO_RETRY, resilience=policy)
+    for _ in range(2):
+        with pytest.raises(TransportError):
+            go(_stream_items(c))
+    t["now"] += 1.1
+    breaker = policy.breakers.get("https://a.example", "fake-model")
+
+    async def probe_under_deadline():
+        token = Deadline(0.05).activate()
+        try:
+            return await _stream_items(c)
+        finally:
+            Deadline.deactivate(token)
+
+    with pytest.raises(DeadlineExceededError):
+        go(probe_under_deadline())
+    # our budget ran out before the upstream answered: neither a success
+    # (which would close the breaker unprobed) nor a failure -- half-open
+    # with the slot returned, so the next attempt really probes
+    assert breaker.state == HALF_OPEN
+    items = go(_stream_items(c))
+    assert items[0].choices[0].delta.content == "real probe"
+    assert breaker.state == CLOSED
+
+
 def test_retry_budget_stops_backoff_loop():
     # generous backoff but a dry shared budget: exactly one retry happens
     budget = RetryBudget(1)
@@ -550,6 +674,77 @@ def test_hedge_not_launched_when_primary_fast():
     assert len(transport.requests) == 1
     assert "hedge_launched" not in policy.counters
     assert len(policy.hedge.tracker) == 1  # committed latency observed
+
+
+def _with_budget(budget, coro_fn):
+    async def run():
+        token = budget.activate()
+        try:
+            return await coro_fn()
+        finally:
+            RetryBudget.deactivate(token)
+
+    return run()
+
+
+def test_hedge_spends_retry_budget():
+    policy = ResiliencePolicy(hedge=HedgePolicy(delay_ms=20.0))
+    transport = FakeTransport(
+        [
+            Script([chunk_obj("slow")], delays={0: 1.0}),
+            Script([chunk_obj("backup wins")]),
+        ]
+    )
+    c = DefaultChatClient(transport, AB, backoff=NO_RETRY, resilience=policy)
+    budget = RetryBudget(1)
+    items = go(_with_budget(budget, lambda: _stream_items(c)))
+    assert items[0].choices[0].delta.content == "backup wins"
+    assert budget.spent == 1  # the hedge drew its token
+    assert policy.counters["hedge_launched"] == 1
+
+
+def test_hedge_denied_when_retry_budget_dry():
+    # under a brown-out the budget dries up exactly when hedge delays
+    # fire: the backup must NOT launch, the primary is simply awaited
+    policy = ResiliencePolicy(hedge=HedgePolicy(delay_ms=20.0))
+    transport = FakeTransport(
+        [Script([chunk_obj("slow but fine")], delays={0: 0.2})]
+    )
+    c = DefaultChatClient(transport, AB, backoff=NO_RETRY, resilience=policy)
+    budget = RetryBudget(1)
+    assert budget.try_acquire()  # drained before the request
+    items = go(_with_budget(budget, lambda: _stream_items(c)))
+    assert items[0].choices[0].delta.content == "slow but fine"
+    assert len(transport.requests) == 1  # no backup launched
+    assert policy.counters["hedge_denied"] == 1
+    assert "hedge_launched" not in policy.counters
+
+
+def test_cancelled_hedge_race_discards_both_attempts():
+    policy = ResiliencePolicy(hedge=HedgePolicy(delay_ms=10.0))
+    transport = FakeTransport(
+        [
+            Script([chunk_obj("slow-a")], delays={0: 30.0}),
+            Script([chunk_obj("slow-b")], delays={0: 30.0}),
+        ]
+    )
+    c = DefaultChatClient(transport, AB, backoff=NO_RETRY, resilience=policy)
+
+    async def run():
+        task = asyncio.ensure_future(_stream_items(c))
+        await asyncio.sleep(0.1)  # primary and backup both in flight
+        assert policy.counters["hedge_launched"] == 1
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        # neither attempt survives the caller's cancellation: no orphaned
+        # tasks pumping abandoned upstream streams
+        pending = [
+            p for p in asyncio.all_tasks() if p is not asyncio.current_task()
+        ]
+        assert pending == []
+
+    go(run())
 
 
 def three_judge_model():

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Device-free host-path benchmark: tokenize + ballot + merge per request.
+
+The r5 review claimed the host half of a consensus request (WordPiece
+tokenization of the candidate texts, ballot construction, J judge streams
+merged through the score engine) dropped from 42.4 ms to 6.6 ms, but no
+harness made that claim driver-measurable without a TPU.  This bench runs
+the REAL host path — ``WordPieceTokenizer.encode_batch``, the seeded
+``PrefixTree`` ballot, ``ScoreClient.create_streaming`` with the full
+per-judge stream merge and weighted tally — against scripted in-memory
+upstream judges (tests/fakes.py transport), so it needs no device, no
+network, and no jax.
+
+Device-free is enforced, not aspirational: the tokenizer module is loaded
+standalone (bypassing ``models/__init__`` which imports the jax encoders)
+and the final record carries ``"jax_imported": false`` asserted from
+``sys.modules``.
+
+Per request: tokenize N candidate texts to the serving seq length, then
+stream one full consensus (initial candidate chunk, J judge ballots,
+final tally frame) through the engine.  Prints ONE JSON line with
+p50/p99 per-request ms and a tokenize / score-engine breakdown.
+
+Run: python bench_host.py            (8 judges x N=64, 50 requests)
+     python bench_host.py --requests 5   (smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib.util
+import json
+import os
+import random
+import statistics
+import sys
+import time
+
+
+def _load_tokenizer_module():
+    """Load models/tokenizer.py WITHOUT importing the models package
+    (whose __init__ imports the jax encoders)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(
+        here, "llm_weighted_consensus_tpu", "models", "tokenizer.py"
+    )
+    spec = importlib.util.spec_from_file_location("_lwc_host_tokenizer", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def host_tokenizer():
+    """Same vocab as bench.bench_tokenizer, built against the standalone
+    tokenizer module (bench.bench_tokenizer itself would import jax)."""
+    from bench import BENCH_WORDS
+
+    tok_mod = _load_tokenizer_module()
+    alphanum = "abcdefghijklmnopqrstuvwxyz0123456789"
+    tokens = (
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]"]
+        + BENCH_WORDS
+        + list(alphanum)
+        + ["##" + c for c in alphanum]
+    )
+    vocab = {t: i for i, t in enumerate(dict.fromkeys(tokens))}
+    return tok_mod.WordPieceTokenizer(vocab)
+
+
+def build_engine(judges: int, n: int, requests: int, seed: int):
+    """A ScoreClient over scripted judge streams: ``requests`` consensus
+    calls' worth of scripts (judges make exactly one attempt each — no
+    retries), plus the params/model objects they score against."""
+    from llm_weighted_consensus_tpu import archive, registry
+    from llm_weighted_consensus_tpu.ballot import PrefixTree, branch_limit
+    from llm_weighted_consensus_tpu.clients.chat import (
+        ApiBase,
+        BackoffPolicy,
+        DefaultChatClient,
+    )
+    from llm_weighted_consensus_tpu.clients.score import ScoreClient
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"),
+    )
+    from fakes import FakeTransport, Script, chunk_obj
+
+    # replay the seeded ballot the engine will build (rng_factory below
+    # hands it the same stream) so judges vote real keys
+    rng = random.Random(seed)
+    tree = PrefixTree.build(rng, n, branch_limit(None))
+    keys = {idx: key for key, idx in tree.key_indices(rng)}
+
+    def judge_script(key):
+        return Script(
+            [
+                chunk_obj("I pick ", model="up-model"),
+                chunk_obj(f"{key} as best.", model="up-model", finish="stop"),
+            ]
+        )
+
+    vote_rng = random.Random(seed + 1)
+    scripts = []
+    for _ in range(requests):
+        # a contested vote: each judge picks among the top few candidates
+        for _ in range(judges):
+            scripts.append(judge_script(keys[vote_rng.randrange(3)]))
+
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport,
+        [ApiBase("https://up.example", "key")],
+        backoff=BackoffPolicy(max_elapsed_ms=0),
+    )
+    client = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(seed),
+    )
+    model_json = {
+        "llms": [
+            {
+                "model": f"judge-{j}",
+                "weight": {"type": "static", "weight": 1 + j % 3},
+            }
+            for j in range(judges)
+        ]
+    }
+    return client, model_json
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--judges", type=int, default=8)
+    ap.add_argument("--n", type=int, default=64, help="candidates/request")
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--requests", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    from bench import BASELINE_BASIS, make_requests
+    from llm_weighted_consensus_tpu.types.score_request import (
+        ChatCompletionCreateParams as ScoreParams,
+    )
+
+    tok = host_tokenizer()
+    client, model_json = build_engine(
+        args.judges, args.n, args.requests, args.seed
+    )
+    texts_per_request = make_requests(args.requests, args.n, seed=args.seed)
+
+    async def score_one(texts):
+        params = ScoreParams.from_json_obj(
+            {
+                "messages": [{"role": "user", "content": "pick the best"}],
+                "model": model_json,
+                "choices": texts,
+            }
+        )
+        stream = await client.create_streaming(None, params)
+        return [item async for item in stream]
+
+    loop = asyncio.new_event_loop()
+    tokenize_ms, score_ms, total_ms = [], [], []
+    chunks_seen = 0
+    # warmup: first call pays lazy imports / codepath warm
+    loop.run_until_complete(score_one(texts_per_request[0][: args.n]))
+    # re-arm scripts consumed by warmup
+    client, model_json = build_engine(
+        args.judges, args.n, args.requests, args.seed
+    )
+    for texts in texts_per_request:
+        t0 = time.perf_counter()
+        ids, mask = tok.encode_batch(texts, args.seq)
+        t1 = time.perf_counter()
+        items = loop.run_until_complete(score_one(texts))
+        t2 = time.perf_counter()
+        assert ids.shape == (args.n, args.seq) and mask.shape == ids.shape
+        chunks_seen += len(items)
+        tokenize_ms.append((t1 - t0) * 1e3)
+        score_ms.append((t2 - t1) * 1e3)
+        total_ms.append((t2 - t0) * 1e3)
+    loop.close()
+
+    def pct(xs, q):
+        return round(
+            statistics.quantiles(xs, n=100)[q - 1] if len(xs) >= 2 else xs[0],
+            3,
+        )
+
+    record = {
+        "metric": (
+            f"host path ms/request (tokenize + ballot + merge), "
+            f"{args.judges} judges x N={args.n}"
+        ),
+        "value": pct(total_ms, 50),
+        "unit": "ms",
+        "p50_ms": pct(total_ms, 50),
+        "p99_ms": pct(total_ms, 99),
+        "breakdown": {
+            "tokenize_p50_ms": pct(tokenize_ms, 50),
+            "score_engine_p50_ms": pct(score_ms, 50),
+        },
+        "requests": args.requests,
+        "judges": args.judges,
+        "n_candidates": args.n,
+        "seq": args.seq,
+        "stream_chunks_per_request": chunks_seen / max(1, args.requests),
+        "jax_imported": "jax" in sys.modules,
+        "baseline_basis": BASELINE_BASIS,
+        "note": (
+            "real host path (WordPiece encode_batch, seeded PrefixTree "
+            "ballot, ScoreClient stream merge + weighted tally) over "
+            "scripted in-memory judges; no device, no network, no jax"
+        ),
+    }
+    assert record["jax_imported"] is False, "host bench must stay device-free"
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,7 +54,12 @@ import numpy as np
 
 sys.path.insert(0, ".")
 
-from bench import BENCH_WORDS, bench_tokenizer, make_requests  # noqa: E402
+from bench import (  # noqa: E402
+    BASELINE_BASIS,
+    BENCH_WORDS,
+    bench_tokenizer,
+    make_requests,
+)
 
 
 def emit(endpoint: str, value: float, unit: str, **extra) -> None:
@@ -64,6 +69,7 @@ def emit(endpoint: str, value: float, unit: str, **extra) -> None:
                 "endpoint": endpoint,
                 "value": round(value, 3),
                 "unit": unit,
+                "baseline_basis": BASELINE_BASIS,
                 **extra,
             }
         ),

@@ -33,7 +33,7 @@ def run_inprocess() -> None:
     import jax
     import numpy as np
 
-    from bench import bench_tokenizer, make_requests
+    from bench import BASELINE_BASIS, bench_tokenizer, make_requests
     from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
     from llm_weighted_consensus_tpu.parallel.collectives import (
         sharded_cosine_vote,
@@ -82,6 +82,7 @@ def run_inprocess() -> None:
                     "host_dispatches_per_request": 2,
                     "collective_matches_single_device": True,
                     "confidence_sum": round(float(conf.sum()), 6),
+                    "baseline_basis": BASELINE_BASIS,
                 }
             ),
             flush=True,
@@ -207,6 +208,7 @@ def run_load_test() -> None:
                     "projected_v5e8_answers_per_sec": round(
                         dp * 1000.0 / measured_single_chip_ms, 1
                     ),
+                    "baseline_basis": BASELINE_BASIS,
                     "note": (
                         "virtual devices timeshare one physical core; "
                         "the projection column multiplies the verified "

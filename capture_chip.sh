@@ -6,7 +6,8 @@
 #   1. bench.py                      (bf16 headline, BASELINE metric)
 #   2. bench.py --quantize int8     (the 10x lever, VERDICT r5 item 2)
 #   3. bench_http.py                (HTTP-edge served-vs-direct, item 3)
-#   4. bench_all.py                 (configs 1-6 refresh, item 4;
+#   4. bench_all.py                 (configs 1-7 refresh incl. int8
+#                                    headline, item 4;
 #                                    --quick unless CAPTURE_FULL=1)
 #   5. bench_scaling.py             (dp-scaling structure + projection)
 #

@@ -15,6 +15,14 @@ from typing import Optional
 
 from .types.base import ResponseError  # noqa: F401  (canonical home: type core)
 
+# Every masking site — anywhere internal detail is folded into a uniform
+# client envelope with the real exception diverted to the server log —
+# logs under THIS name.  One constant so an operator tailing one logger
+# sees all masked detail; it matches the serving stack's configured
+# logger ("lwc.serve", serve/__main__.py) because masked errors only
+# arise on client-visible surfaces, which the gateway owns.
+MASKING_LOGGER = "lwc.serve"
+
 
 def _status_phrase(code: int) -> str:
     try:
@@ -50,7 +58,7 @@ def to_response_error(err) -> ResponseError:
     # envelope, src/error.rs:8-13, never echoes internals; neither do we).
     import logging
 
-    logging.getLogger("lwc").error(
+    logging.getLogger(MASKING_LOGGER).error(
         "unexpected error folded into response envelope",
         exc_info=err if isinstance(err, BaseException) else None,
     )

@@ -32,6 +32,7 @@ from .layers import (
     gelu_erf as _gelu_erf,
     layer_norm as _layer_norm,
     ln_init as _ln_init,
+    mlp_cfg as _mlp_cfg,
 )
 
 
@@ -181,9 +182,8 @@ def _use_fused_attention(
 def _layer(x, p, mask_bias, config: BertConfig):
     attn = _attention(x, p, mask_bias, config)
     x = _layer_norm(x + attn, p["attn_ln"], config.layer_norm_eps)
-    mlp = _dense_cfg(
-        _gelu_erf(_dense_cfg(x, p["mlp_in"], config)), p["mlp_out"], config
-    )
+    # GELU fuses into the mlp_in epilogue on the int8 path (layers.mlp_cfg)
+    mlp = _mlp_cfg(x, p["mlp_in"], p["mlp_out"], config)
     return _layer_norm(x + mlp, p["mlp_ln"], config.layer_norm_eps)
 
 

@@ -37,6 +37,7 @@ from .layers import (
     gelu_erf as _gelu_erf,
     layer_norm as _layer_norm,
     ln_init as _ln_init,
+    mlp_cfg as _mlp_cfg,
 )
 
 
@@ -180,15 +181,12 @@ def encode(
     def body(carry, layer_p):
         attn = _disentangled_attention(carry, rel, layer_p, mask_bias, config)
         y = _layer_norm(carry + attn, layer_p["attn_ln"], config.layer_norm_eps)
-        # exact-erf GELU (bert._gelu_erf: exact for f32, A&S for bf16):
+        # exact-erf GELU (layers.gelu_erf: exact for f32, A&S for bf16):
         # HF deberta-v2's hidden_act is "gelu" = erf — jax.nn.gelu's
         # default tanh approximation silently diverged here (r4 fix; the
-        # head below already used approximate=False)
-        mlp = _dense_cfg(
-            _gelu_erf(_dense_cfg(y, layer_p["mlp_in"], config)),
-            layer_p["mlp_out"],
-            config,
-        )
+        # head below already used approximate=False).  On the int8 path
+        # mlp_cfg folds the GELU into the mlp_in kernel epilogue.
+        mlp = _mlp_cfg(y, layer_p["mlp_in"], layer_p["mlp_out"], config)
         return _layer_norm(y + mlp, layer_p["mlp_ln"], config.layer_norm_eps), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])

@@ -213,7 +213,17 @@ class TpuEmbedder:
 
         self.config, params = resolve_quantize(self.config, params, quantize)
         self.params = params
-        self.put_batch = lambda ids, mask: (ids, mask)  # mesh hook
+        put_batch = lambda ids, mask: (ids, mask)  # mesh hook
+        # marks the hook as the identity default: AOT executables bake
+        # input shardings at lowering time, so replacing the hook (mesh
+        # sharding) disables the AOT fast path (_aot_ready)
+        put_batch._lwc_default = True
+        self.put_batch = put_batch
+        # AOT-compiled executables (aot_warmup) keyed by call signature;
+        # the dispatch methods consult this FIRST, so warmed buckets
+        # never touch the jit dispatch cache (zero new specializations
+        # after startup — see jit_stats)
+        self._aot = {}
         # batches are padded up to a multiple of this before dispatch so
         # the dp split divides evenly (shard_embedder sets it to dp)
         self.batch_multiple = 1
@@ -224,6 +234,118 @@ class TpuEmbedder:
         self.embed_override = None
         # introspection: the sequence-parallel mesh when sp-sharded
         self.sp_mesh = None
+
+    # -- AOT bucket precompile ------------------------------------------------
+
+    def _aot_ready(self) -> bool:
+        """Whether the AOT fast path is usable: single-device dispatch
+        only.  Mesh-sharded embedders (put_batch replaced, embed_override
+        set, or dp batch padding) bake shardings/shapes the plain-aval
+        lowering below doesn't carry — they keep the lazy-jit path."""
+        return (
+            self.embed_override is None
+            and getattr(self.put_batch, "_lwc_default", False)
+            and self.batch_multiple == 1
+        )
+
+    def _aot_lookup(self, key, ids, mask):
+        if not self._aot or not self._aot_ready():
+            return None
+        # executables were lowered for int32 ids/mask (the tokenizer's
+        # dtype); anything else falls back to the jit path rather than
+        # tripping the compiled call's aval check
+        if ids.dtype != np.int32 or mask.dtype != np.int32:
+            return None
+        return self._aot.get(key)
+
+    def aot_warmup(self, specs: list, r_buckets: list = ()) -> list:
+        """AOT-lower-and-compile (``.lower().compile()``) every serving
+        bucket up front: for each (N, S) spec the single-request consensus
+        dispatch (both vote variants — the fused-kernel default and the
+        traced-temperature fallback), the plain embed forward at its
+        padded batch bucket, and (per ``r_buckets`` entry >= 2) the
+        batcher's grouped dispatch.
+
+        The executables land in ``self._aot`` and the dispatch methods
+        call them directly, bypassing jit dispatch entirely — post-warmup
+        traffic at warmed buckets creates ZERO new jit specializations
+        (``.lower().compile()`` alone does not populate the jit dispatch
+        cache on jax 0.4.x, so caching the executables ourselves is what
+        makes the warmup stick).  With ``COMPILE_CACHE_DIR`` set the
+        lowering also lands in the persistent XLA cache, so restarts
+        deserialize instead of recompiling.  Returns [(label, seconds)]
+        for startup logging."""
+        import time as _time
+
+        if not self._aot_ready():
+            raise RuntimeError(
+                "AOT warmup needs the single-device embedder; mesh-sharded "
+                "embedders warm via real dispatches (serve/__main__.py)"
+            )
+        sds = jax.ShapeDtypeStruct
+        temp_av = sds((), jnp.float32)
+        timings = []
+        for n, s in specs:
+            s = _seq_bucket(s, self.max_tokens)
+            ids_av = sds((n, s), jnp.int32)
+            for use_fused in (True, False):
+                key = ("vote1", n, s, use_fused)
+                if key in self._aot:
+                    continue
+                t0 = _time.perf_counter()
+                self._aot[key] = _embed_and_vote.lower(
+                    self.params, ids_av, ids_av, temp_av,
+                    n, self.config, self.pooling, use_fused,
+                ).compile()
+                timings.append((
+                    f"consensus {n}x{s} fused={use_fused}",
+                    _time.perf_counter() - t0,
+                ))
+            pad_b = _bucket(n, self.MAX_DEVICE_BATCH)
+            key = ("embed", pad_b, s)
+            if key not in self._aot:
+                b_av = sds((pad_b, s), jnp.int32)
+                t0 = _time.perf_counter()
+                self._aot[key] = bert.embed.lower(
+                    self.params, b_av, b_av, self.config,
+                    pooling=self.pooling, normalize=True,
+                ).compile()
+                timings.append((
+                    f"embed {pad_b}x{s}", _time.perf_counter() - t0
+                ))
+            for r in r_buckets:
+                if r < 2:
+                    continue  # R=1 groups dispatch the single-request path
+                key = ("many", r, n, s)
+                if key in self._aot:
+                    continue
+                flat_av = sds((r * n, s), jnp.int32)
+                t0 = _time.perf_counter()
+                self._aot[key] = _embed_and_vote_many.lower(
+                    self.params, flat_av, flat_av, temp_av,
+                    r, n, self.config, self.pooling,
+                ).compile()
+                timings.append((
+                    f"grouped R={r} {n}x{s}", _time.perf_counter() - t0
+                ))
+        return timings
+
+    def jit_stats(self) -> dict:
+        """Jit-cache introspection: AOT bucket count + per-entry-point
+        specialization counts (serve /metrics "jit" section; the warmup
+        test asserts the counts stay flat under post-warmup load)."""
+        return {
+            "aot_buckets": len(self._aot),
+            "specializations": {
+                "embed_and_vote": _embed_and_vote._cache_size(),
+                "embed_and_vote_many": _embed_and_vote_many._cache_size(),
+                "embed": bert.embed._cache_size(),
+                "stream_vote_update": _stream_vote_update._cache_size(),
+                "stream_vote_update_many": (
+                    _stream_vote_update_many._cache_size()
+                ),
+            },
+        }
 
     # -- core ----------------------------------------------------------------
 
@@ -262,6 +384,11 @@ class TpuEmbedder:
             mask = np.pad(mask, ((0, pad_b - b), (0, 0)))
         if self.embed_override is not None:
             return np.asarray(self.embed_override(ids, mask)[:b])
+        exe = self._aot_lookup(("embed", pad_b, ids.shape[1]), ids, mask)
+        if exe is not None:
+            return np.asarray(
+                exe(self.params, jnp.asarray(ids), jnp.asarray(mask))[:b]
+            )
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
         emb = bert.embed(
             self.params,
@@ -300,6 +427,19 @@ class TpuEmbedder:
     ):
         n = ids.shape[0]
         ids, mask = self._pad_rows(ids, mask)
+        # the Pallas fast path bakes its temperature in; any other
+        # value rides the traced-jnp vote (no per-value recompiles)
+        use_fused = float(temperature) == DEFAULT_VOTE_TEMPERATURE
+        exe = self._aot_lookup(
+            ("vote1", ids.shape[0], ids.shape[1], use_fused), ids, mask
+        )
+        if exe is not None:
+            return exe(
+                self.params,
+                jnp.asarray(ids),
+                jnp.asarray(mask),
+                jnp.asarray(float(temperature), jnp.float32),
+            )
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
         return _embed_and_vote(
             self.params,
@@ -309,9 +449,7 @@ class TpuEmbedder:
             n,
             self.config,
             self.pooling,
-            # the Pallas fast path bakes its temperature in; any other
-            # value rides the traced-jnp vote (no per-value recompiles)
-            use_fused=float(temperature) == DEFAULT_VOTE_TEMPERATURE,
+            use_fused=use_fused,
         )
 
     def consensus_confidence_tokens_many(
@@ -342,6 +480,17 @@ class TpuEmbedder:
             ids = ids.reshape(r * n, s)
             mask = mask.reshape(r * n, s)
         flat_ids, flat_mask = self._pad_rows(ids, mask)
+        exe = self._aot_lookup(
+            ("many", r_bucket, n, s), flat_ids, flat_mask
+        )
+        if exe is not None:
+            conf = exe(
+                self.params,
+                jnp.asarray(flat_ids),
+                jnp.asarray(flat_mask),
+                jnp.asarray(float(temperature), jnp.float32),
+            )
+            return conf[:r]
         dev_ids, dev_mask = self.put_batch(
             jnp.asarray(flat_ids), jnp.asarray(flat_mask)
         )

@@ -58,11 +58,27 @@ def dense_cfg(x, p: dict, config):
     matmul (dense above) or the W8A8 int8-MXU twin (models/quant.py) —
     selected statically by ``config.quantize``, so the jit sees one path.
     Shared by every model family (bert, deberta)."""
-    if config.quantize == "int8":
-        from .quant import dense_int8
+    if config.quantize.startswith("int8"):
+        from .quant import dense_int8, impl_for
 
-        return dense_int8(x, p)
+        return dense_int8(x, p, impl=impl_for(config.quantize))
     return dense(x, p)
+
+
+def mlp_cfg(x, p_in: dict, p_out: dict, config):
+    """The encoder MLP (dense -> GELU -> dense) under the config's
+    quantize mode.  Full precision keeps the dense/gelu_erf composition;
+    int8 modes route BOTH matmuls through dense_int8 with the GELU folded
+    into the expansion matmul's kernel epilogue (ops/kernels.w8a8_matmul)
+    — the [B*S, intermediate] GELU input never round-trips HBM between
+    separate quant/matmul/activation passes."""
+    if config.quantize.startswith("int8"):
+        from .quant import dense_int8, impl_for
+
+        impl = impl_for(config.quantize)
+        h = dense_int8(x, p_in, gelu=True, impl=impl)
+        return dense_int8(h, p_out, impl=impl)
+    return dense(gelu_erf(dense(x, p_in)), p_out)
 
 
 def gelu_erf(x: jax.Array) -> jax.Array:
@@ -87,9 +103,15 @@ def gelu_erf(x: jax.Array) -> jax.Array:
     shows).  Asserted exhaustively over every finite bf16 input in
     tests/test_models.py."""
     x32 = x.astype(jnp.float32)
-    if x.dtype != jnp.bfloat16:
-        out = x32 * 0.5 * (1.0 + jax.lax.erf(x32 * (2.0 ** -0.5)))
-        return out.astype(x.dtype)
+    return gelu_f32(x32, approx=x.dtype == jnp.bfloat16).astype(x.dtype)
+
+
+def gelu_f32(x32: jax.Array, approx: bool = False) -> jax.Array:
+    """The f32 GELU core behind gelu_erf, split out so the W8A8 kernel
+    epilogue (ops/kernels.py) applies the IDENTICAL math — same exact-erf
+    vs A&S-7.1.26 split, same coefficients — inside the fused matmul."""
+    if not approx:
+        return x32 * 0.5 * (1.0 + jax.lax.erf(x32 * (2.0 ** -0.5)))
     z = jnp.abs(x32) * (2.0 ** -0.5)
     t = 1.0 / (1.0 + 0.3275911 * z)
     poly = t * (
@@ -102,4 +124,4 @@ def gelu_erf(x: jax.Array) -> jax.Array:
     )
     half_erfc = 0.5 * poly * jnp.exp(-z * z)
     phi = jnp.where(x32 > 0, 1.0 - half_erfc, half_erfc)
-    return (x32 * phi).astype(x.dtype)
+    return x32 * phi

@@ -12,12 +12,25 @@ Scheme — the standard symmetric W8A8 recipe:
 
 * weights: per-OUTPUT-channel symmetric int8 (scale[out] = max|W[:,o]|/127,
   quantized ONCE at load time);
-* activations: per-ROW dynamic symmetric int8 (scale[row] = max|x[row]|/127,
-  quantized at trace time inside the jit — XLA fuses the quant pass into
-  the surrounding elementwise work);
+* activations: per-ROW dynamic symmetric int8 (scale[row] = max|x[row]|/127);
 * matmul: int8 x int8 with int32 accumulation on the MXU
   (``preferred_element_type=int32`` — exact), dequantized by the rank-1
-  outer product of the two scales, bias added in the activation dtype.
+  outer product of the two scales, bias added.
+
+Two implementations of the same math, selected by ``impl_for``:
+
+* ``pallas`` (TPU default) — ops/kernels.w8a8_matmul: activation quant +
+  int8 matmul + dequant/bias(+GELU) epilogue fused in ONE kernel, so the
+  quantized activations, the int32 accumulator, and any dequantized
+  weight copy stay in VMEM — nothing but the input and the finished
+  output touches HBM;
+* ``xla`` (non-TPU default / VMEM-overflow fallback) — the jnp
+  composition below with ``preferred_element_type=int32``; XLA fuses the
+  quant pass into surrounding elementwise work but stages the int8
+  activations through HBM.
+
+The mode strings ``int8-pallas`` / ``int8-xla`` pin an implementation
+(tests, debugging); plain ``int8`` auto-selects per backend.
 
 What stays un-quantized, deliberately: attention QK^T/PV (bf16, already
 cheap and softmax-sensitive), layernorm/softmax (f32 module contract),
@@ -57,12 +70,47 @@ def _quantize_rows(x: jax.Array):
     return q, scale
 
 
-def dense_int8(x: jax.Array, p: dict) -> jax.Array:
+QUANT_MODES = ("none", "int8", "int8-pallas", "int8-xla")
+
+
+def impl_for(mode: str) -> str:
+    """Quantize mode string -> dense_int8 implementation name.
+
+    Called at trace time, so the backend probe is a compile-time constant
+    — the jit sees exactly one path."""
+    if mode == "int8":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if mode in ("int8-pallas", "int8-xla"):
+        return mode[len("int8-"):]
+    raise ValueError(f"quantize={mode!r} is not an int8 mode")
+
+
+def dense_int8(
+    x: jax.Array, p: dict, *, gelu: bool = False, impl: str = None
+) -> jax.Array:
     """W8A8 dense: x[..., in] @ p["kernel_q"][in, out] -> [..., out].
 
     int32 accumulation on the MXU (exact), dequantized by
-    act_scale x weight_scale, bias in the activation dtype — the
-    quantized twin of layers.dense."""
+    act_scale x weight_scale, plus bias — the quantized twin of
+    layers.dense.  ``gelu=True`` appends the exact-profile GELU
+    (layers.gelu_erf semantics), fused into the kernel epilogue on the
+    pallas impl.  ``impl`` pins "pallas"/"xla"; None auto-selects
+    (pallas on TPU, xla elsewhere)."""
+    if impl is None:
+        impl = impl_for("int8")
+    if impl == "pallas":
+        from ..ops.kernels import w8a8_matmul, w8a8_shape_fits
+
+        m = 1
+        for d in x.shape[:-1]:
+            m *= d
+        k = x.shape[-1]
+        n = p["kernel_q"].shape[-1]
+        if w8a8_shape_fits(m, k, n, jnp.dtype(x.dtype).itemsize):
+            return w8a8_matmul(
+                x, p["kernel_q"], p["scale"], p["bias"], gelu=gelu
+            )
+        # weight block too big for VMEM: the XLA composition below
     xq, sx = _quantize_rows(x)
     acc = jax.lax.dot_general(
         xq,
@@ -71,7 +119,12 @@ def dense_int8(x: jax.Array, p: dict) -> jax.Array:
         preferred_element_type=jnp.int32,
     )
     out = acc.astype(jnp.float32) * sx[..., None] * p["scale"]
-    return out.astype(x.dtype) + p["bias"]
+    out = out.astype(x.dtype) + p["bias"]
+    if gelu:
+        from .layers import gelu_erf
+
+        out = gelu_erf(out)
+    return out
 
 
 _QUANT_LAYER_KERNELS = (
@@ -117,9 +170,9 @@ def resolve_quantize(config, params: dict, quantize: str):
     (frozen dataclass) config, and quantizes full-precision params once
     at load — pre-quantized pytrees pass through.  Returns
     (config, params)."""
-    if quantize not in ("none", "int8"):
+    if quantize not in QUANT_MODES:
         raise ValueError(
-            f"quantize={quantize!r}: expected 'none' or 'int8'"
+            f"quantize={quantize!r}: expected one of {QUANT_MODES}"
         )
     if quantize == "none":
         return config, params

@@ -49,6 +49,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax 0.4.x names it TPUCompilerParams; newer jax renamed it
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 # One (s, s) f32 score tile + 3 (s, hd) operand tiles must fit VMEM.
 MAX_FUSED_SEQ = 1024
 
@@ -133,9 +138,7 @@ def fused_attention_tiled(
         out_specs=qkv_spec,
         out_shape=jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
         # independent grid steps: lets Mosaic double-buffer the block DMAs
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",)
-        ),
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
         interpret=_interpret(),
     )(to_heads(q), to_heads(k), to_heads(v), flat_bias)
     return out.reshape(b, nh, s, hd).transpose(0, 2, 1, 3)

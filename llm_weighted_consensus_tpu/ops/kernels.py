@@ -6,6 +6,11 @@ VMEM instead of round-tripping HBM between XLA ops):
 * ``fused_cosine_vote``  — l2-normalize + pairwise cosine + mean-off-diag +
   masked softmax in one pass (the whole self-consistency scorer); the
   serving hot path's scorer (models/embedder.py, clients/multichat.py).
+* ``w8a8_matmul``        — fused W8A8 quantized dense: per-row dynamic
+  activation int8 quant + int8 x int8 -> int32 MXU matmul + dequant/bias
+  (+ optional GELU) epilogue in one kernel, so neither the quantized
+  activations nor the int32 accumulator nor a dequantized bf16 weight
+  copy ever materializes in HBM (models/quant.py's fast path).
 
 (A fused tally kernel existed but was removed: the live tally is host
 Decimal by product contract and batched re-scoring uses
@@ -94,3 +99,114 @@ def fused_cosine_vote(
         interpret=_interpret(),
     )(x)
     return out[0, :n]
+
+
+# ---------------------------------------------------------------------------
+# Fused W8A8 quantized matmul (the int8 serving path's dense op)
+# ---------------------------------------------------------------------------
+
+# Grid over M row tiles with the whole [K, N] int8 weight block resident
+# in VMEM: the weight BlockSpec's index map is constant, so the Mosaic
+# pipeline DMAs it once and revisits it across grid steps — activations
+# stream through while the weights stay put (the opposite split would
+# re-fetch the big operand per tile).
+W8A8_TILE_M = 128
+# weight block + double-buffered x/out tiles must fit comfortably under
+# the ~16 MB/core VMEM; beyond this the caller's dot_general fallback
+# (models/quant.py) takes over
+_W8A8_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def w8a8_shape_fits(m: int, k: int, n: int, x_bytes: int) -> bool:
+    """Whether the single-weight-block tiling fits the VMEM budget.
+
+    Every preset's encoder matmul fits (bge-large mlp_in, the largest:
+    1 MB x-tile + 4 MB int8 weights + 4 MB f32 out-tile, double-buffered
+    tiles well under 12 MB); the gate exists for hypothetical huge
+    projections, which fall back to the XLA int8 dot_general."""
+    kp = _round_up(k, 128)
+    np_ = _round_up(n, 128)
+    tm = min(W8A8_TILE_M, _round_up(m, 8))
+    weight = kp * np_  # int8: 1 byte
+    tiles = 2 * tm * kp * x_bytes + 2 * tm * np_ * x_bytes  # double-buffered
+    scale_bias = 2 * np_ * 4
+    return weight + tiles + scale_bias <= _W8A8_VMEM_BUDGET
+
+
+def _w8a8_kernel(x_ref, wq_ref, sw_ref, b_ref, o_ref, *, gelu, approx_gelu):
+    # lazy: ops must not import models at module load (models/__init__
+    # imports embedder, which imports this module)
+    from ..models.layers import gelu_f32
+
+    x = x_ref[:].astype(jnp.float32)  # [TM, Kp]; pad rows/cols are zero
+    # per-row dynamic activation quant, fused: the int8 activations never
+    # leave VMEM (the whole point — a separate XLA quant pass would write
+    # xq + scale to HBM and read them back)
+    sx = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0  # [TM, 1]
+    sx = jnp.maximum(sx, 1e-12)
+    xq = jnp.clip(jnp.round(x / sx), -127.0, 127.0).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq,
+        wq_ref[:],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [TM, Np] int32 on the MXU, exact
+    # epilogue: rank-1 dequant (sx x sw) + bias (+ GELU), all in f32 in
+    # registers, single cast on the way out
+    out = acc.astype(jnp.float32) * sx * sw_ref[:] + b_ref[:]
+    if gelu:
+        out = gelu_f32(out, approx=approx_gelu)
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+def w8a8_matmul(
+    x: jax.Array,
+    wq: jax.Array,
+    sw: jax.Array,
+    bias: jax.Array,
+    *,
+    gelu: bool = False,
+    interpret=None,
+) -> jax.Array:
+    """Fused W8A8 dense: ``x[..., K] @ wq[K, N] -> [..., N]`` in x.dtype.
+
+    ``wq`` is the per-output-channel int8 kernel and ``sw`` its f32 scale
+    (models/quant.py:quantize_weight); activations are quantized per row
+    INSIDE the kernel.  ``gelu=True`` folds the exact-profile GELU into
+    the epilogue (erf for f32 activations, the A&S 7.1.26 form for bf16 —
+    the same dtype split as layers.gelu_erf, so the fused MLP matches the
+    unfused composition's numerics).  Non-TPU backends run in interpret
+    mode; ``interpret`` overrides for tests."""
+    k = x.shape[-1]
+    n = wq.shape[-1]
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    tm = min(W8A8_TILE_M, _round_up(m, 8))
+    xp = _pad_to(_pad_to(x2, 0, tm), 1, 128)
+    wqp = _pad_to(_pad_to(wq, 0, 128), 1, 128)
+    swp = _pad_to(sw.astype(jnp.float32).reshape(1, n), 1, 128)
+    bp = _pad_to(bias.astype(jnp.float32).reshape(1, n), 1, 128)
+    mp, kp = xp.shape
+    np_ = wqp.shape[1]
+    out = pl.pallas_call(
+        functools.partial(
+            _w8a8_kernel,
+            gelu=gelu,
+            approx_gelu=x.dtype == jnp.bfloat16,
+        ),
+        grid=(mp // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, kp), lambda i: (i, 0)),
+            pl.BlockSpec((kp, np_), lambda i: (0, 0)),
+            pl.BlockSpec((1, np_), lambda i: (0, 0)),
+            pl.BlockSpec((1, np_), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tm, np_), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(xp, wqp, swp, bp)
+    return out[:m, :n].reshape(*x.shape[:-1], n)

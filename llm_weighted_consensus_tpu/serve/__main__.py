@@ -533,7 +533,9 @@ class _ArchivingClient:
                 )
 
 
-def _warmup_embedder(embedder, specs: list, r_buckets: list = ()) -> None:
+def _warmup_embedder(
+    embedder, specs: list, r_buckets: list = (), aot: bool = True
+) -> None:
     """Pre-compile the consensus path for the given ``NxS`` shapes at
     startup (WARMUP env, serve/config.py) so the first real request
     doesn't pay a multi-second jit compile.  Each spec warms the
@@ -547,7 +549,14 @@ def _warmup_embedder(embedder, specs: list, r_buckets: list = ()) -> None:
     dispatch (``consensus_confidence_tokens_many``) at each concurrency
     bucket per shape — a distinct XLA specialization per power-of-two R,
     which the single-request warm does NOT cover (ADVICE r4): without it
-    the first concurrent burst at a warmed NxS still pays the compile."""
+    the first concurrent burst at a warmed NxS still pays the compile.
+
+    ``aot`` (WARMUP_AOT, default on) compiles each bucket ahead-of-time
+    (``TpuEmbedder.aot_warmup``: ``.lower().compile()``, no device
+    dispatch) and serves warmed buckets from the embedder's executable
+    table — zero jit specializations after startup.  Mesh-sharded
+    embedders fall back to the dispatch loop below (the AOT lowering
+    doesn't carry their input shardings)."""
     import logging
     import time as _time
 
@@ -563,6 +572,10 @@ def _warmup_embedder(embedder, specs: list, r_buckets: list = ()) -> None:
             (n, _seq_bucket(s, embedder.max_tokens)) for n, s in specs
         )
     )
+    if aot and embedder._aot_ready():
+        for label, dt in embedder.aot_warmup(snapped, r_buckets):
+            log.info("warmup AOT %s compiled in %.1fs", label, dt)
+        return
     for n, s in snapped:
         ids = np.zeros((n, s), dtype=np.int32)
         mask = np.zeros((n, s), dtype=np.int32)
@@ -650,13 +663,20 @@ def build_service(
     # allowed (still logged); production startup refuses them
     embedder = build_embedder(config, allow_synthetic=fake_upstream)
     if embedder is not None and config.warmup:
-        _warmup_embedder(embedder, config.warmup, config.warmup_r)
+        _warmup_embedder(
+            embedder, config.warmup, config.warmup_r, aot=config.warmup_aot
+        )
     reranker = build_reranker(config, allow_synthetic=fake_upstream)
     from .metrics import Metrics
 
     # metrics exist regardless of the device side: the result cache's
     # counters (and the HTTP series) are host-only observability
     metrics = Metrics()
+    if embedder is not None:
+        # jit-cache introspection on /metrics: AOT bucket count + live
+        # specialization counts (asserting "zero new specializations
+        # post-warmup" is observable in production, not just in tests)
+        metrics.register_provider("jit", embedder.jit_stats)
     score_cache = None
     embed_cache = None
     if config.score_cache_ttl_sec > 0:

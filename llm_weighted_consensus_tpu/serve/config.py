@@ -17,8 +17,11 @@ TPU additions:
   the vocab.txt beside EMBEDDER_WEIGHTS when present, else hash-tokenizer
   fallback.
 * ``EMBEDDER_QUANTIZE`` — ``int8`` serves the encoder W8A8 on the MXU's
-  int8 path (2x bf16 peak; opt-in, accuracy pinned in tests/test_quant.py).
-  Default ``none``.
+  int8 path (2x bf16 peak; opt-in, accuracy pinned in tests/test_quant.py)
+  via the fused Pallas quantized-matmul kernel (activation quant + int8
+  matmul + dequant/bias/GELU epilogue in one kernel — ops/kernels.py).
+  ``int8-pallas`` / ``int8-xla`` pin the kernel vs the XLA dot_general
+  fallback (debugging).  Default ``none``.
 * ``EMBEDDER_MAX_TOKENS`` — truncation window.  Default: the model's full
   position table under ``MESH_SP`` (long-context serving must not silently
   truncate), else 512.
@@ -92,6 +95,13 @@ TPU additions:
   *concurrent* burst at that shape.  Values snap to the next power of
   two (the runtime bucketing) and dedup.  Default empty: only the
   single-request (R=1) path is warmed.
+* ``WARMUP_AOT`` — ``1`` (default): warm via AOT ``.lower().compile()``
+  — every warmed bucket's executable is compiled WITHOUT a device
+  dispatch and cached on the embedder, and post-warmup traffic at those
+  buckets calls the executables directly (zero jit specializations
+  after startup; the ``jit`` section of ``/metrics`` shows the counts).
+  ``0`` falls back to dispatch-based warmup (also what mesh-sharded
+  embedders use: AOT lowering doesn't carry their shardings).
 * ``BATCH_MAX_ROWS`` — encoder rows per fused dispatch; a synchronized
   burst of requests chunks into this many rows per dispatch so the
   pipeline has pieces to overlap.  Default 512.
@@ -334,6 +344,10 @@ class Config:
     # (consensus_confidence_tokens_many) path for, per WARMUP shape
     # (WARMUP_R env, e.g. "2,4"); [] = single-request path only
     warmup_r: list = field(default_factory=list)
+    # AOT-compile warmed buckets (.lower().compile(), no device
+    # dispatch) and serve them from the embedder's executable table;
+    # False = dispatch-based warmup (WARMUP_AOT env)
+    warmup_aot: bool = True
     # consensus result cache (cache/): TTL seconds, 0 = disabled (exact
     # pre-cache behavior); byte budget for the in-memory LRU; optional
     # JSONL disk tier for warm restarts
@@ -436,6 +450,7 @@ class Config:
             batch_max_rows=max(1, int(env.get("BATCH_MAX_ROWS", 512))),
             warmup=_parse_warmup(env.get("WARMUP")),
             warmup_r=_parse_warmup_r(env.get("WARMUP_R")),
+            warmup_aot=env_truthy(env.get("WARMUP_AOT", "1")),
             score_cache_ttl_sec=max(0.0, get_f("SCORE_CACHE_TTL", 0)),
             score_cache_max_bytes=_non_negative_int(
                 env, "SCORE_CACHE_MAX_BYTES", 64 * 1024 * 1024

@@ -87,7 +87,9 @@ def _parse_error_response(e: Exception) -> web.Response:
     else:
         import logging
 
-        logging.getLogger("lwc.serve").error(
+        from ..errors import MASKING_LOGGER
+
+        logging.getLogger(MASKING_LOGGER).error(
             "unexpected parse-phase error", exc_info=e
         )
         message = "malformed request body"

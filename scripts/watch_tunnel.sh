@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Tunnel watcher — probe the TPU backend on a bounded schedule and fire
+# the serial chip capture (capture_chip.sh) the FIRST time the tunnel
+# comes up, committing the probe + capture transcript so the evidence
+# survives the session.
+#
+# The r4/r5 pattern: the tunnel wedges for hours and then recovers at an
+# arbitrary time nobody is watching.  Each probe reuses bench.py's
+# wedge-proof subprocess probe (backend init in a THROWAWAY child with a
+# hard timeout — a wedged tunnel hangs, it does not raise), so the
+# watcher itself can never wedge.  Everything is bounded: per-probe
+# timeout, probe count, and capture_chip.sh's own per-phase timeout.
+#
+# Usage: bash scripts/watch_tunnel.sh [outdir]     (default watch_r6)
+# Env:   WATCH_INTERVAL        seconds between probes   (default 480 ~ 8 min)
+#        WATCH_MAX_PROBES      probe budget             (default 30 ~ 4 h)
+#        WATCH_PROBE_TIMEOUT   per-probe init bound     (default 120 s)
+#        WATCH_NO_COMMIT=1     skip the git commit (tests / CI dry-runs)
+#        CAPTURE_PHASE_TIMEOUT / CAPTURE_FULL   pass through to capture
+#
+# Exit: 0 capture ran and succeeded; 1 capture ran degraded; 2 probe
+# budget exhausted without ever seeing a TPU backend (transcript still
+# committed — negative evidence is evidence).
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-watch_r6}"
+case "$OUT" in /*) ;; *) OUT="$PWD/$OUT" ;; esac
+mkdir -p "$OUT"
+TRANSCRIPT="$OUT/watch_transcript.jsonl"
+INTERVAL="${WATCH_INTERVAL:-480}"
+MAX_PROBES="${WATCH_MAX_PROBES:-30}"
+PROBE_TIMEOUT="${WATCH_PROBE_TIMEOUT:-120}"
+
+log_probe() {  # $1 = probe index; stdin = probe JSON
+  # one JSON line per probe, timestamped, appended even on ^C mid-run
+  while IFS= read -r line; do
+    printf '{"ts": "%s", "probe": %s, "result": %s}\n' \
+      "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$1" "$line" >> "$TRANSCRIPT"
+  done
+}
+
+commit_transcript() {  # $1 = one-line summary for the commit message
+  [ "${WATCH_NO_COMMIT:-}" = 1 ] && return 0
+  git add -f "$TRANSCRIPT" 2>/dev/null
+  # capture output is committed only when the capture actually ran
+  [ -e "$OUT/bench.jsonl" ] && git add -f "$OUT"/*.jsonl "$OUT"/*.err 2>/dev/null
+  git commit -m "watch_tunnel: $1" -- "$OUT" >/dev/null 2>&1 || true
+}
+
+i=0
+while [ "$i" -lt "$MAX_PROBES" ]; do
+  i=$((i + 1))
+  # the probe subprocess is the ONLY thing that touches the backend
+  RESULT=$(python - "$PROBE_TIMEOUT" <<'EOF'
+import json, sys
+from bench import probe_backend
+print(json.dumps(probe_backend(float(sys.argv[1]))))
+EOF
+  ) || RESULT='{"ok": false, "backend": null, "error": "probe runner crashed"}'
+  printf '%s\n' "$RESULT" | log_probe "$i"
+  echo "== probe $i/$MAX_PROBES: $RESULT" >&2
+
+  if printf '%s' "$RESULT" | grep -q '"backend": "tpu"'; then
+    echo "== tunnel up on probe $i: starting serial capture" >&2
+    bash capture_chip.sh "$OUT"
+    rc=$?
+    printf '{"ts": "%s", "capture_rc": %s}\n' \
+      "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$rc" >> "$TRANSCRIPT"
+    commit_transcript "tunnel up on probe $i, capture rc=$rc"
+    exit "$rc"
+  fi
+  [ "$i" -lt "$MAX_PROBES" ] && sleep "$INTERVAL"
+done
+echo "== probe budget exhausted ($MAX_PROBES probes): tunnel never came up" >&2
+printf '{"ts": "%s", "exhausted": true, "probes": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$MAX_PROBES" >> "$TRANSCRIPT"
+commit_transcript "probe budget exhausted after $MAX_PROBES probes, no TPU"
+exit 2

@@ -1,0 +1,113 @@
+"""AOT bucket precompile (TpuEmbedder.aot_warmup + serve warmup wiring).
+
+The serving acceptance this pins: after startup warmup, every traffic
+shape at a warmed (R, N, S) bucket is served from the embedder's
+ahead-of-time compiled executable table — ZERO new jit specializations
+under post-warmup mixed load.  ``.lower().compile()`` alone does not
+populate jax's jit dispatch cache (jax 0.4.x), so the table lookup IS the
+mechanism; these tests assert both the mechanism (table hit, results
+equal the lazy-jit path) and the observable promise (specialization
+counts flat).  Jit caches are process-global, so every assertion is a
+DELTA against a snapshot, never an absolute count.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from llm_weighted_consensus_tpu.models import configs
+from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+
+TINY = configs.TEST_TINY
+N, S, R = 4, 16, 2
+
+
+def make_embedder():
+    return TpuEmbedder("test-tiny", config=TINY, max_tokens=32, seed=3)
+
+
+def mixed_load(embedder):
+    """One of everything the gateway dispatches at a warmed bucket."""
+    rng = np.random.default_rng(12)
+    ids = rng.integers(3, TINY.vocab_size, (N, S)).astype(np.int32)
+    mask = np.ones((N, S), np.int32)
+    out = [
+        np.asarray(embedder.consensus_confidence_tokens(ids, mask)),
+        np.asarray(
+            embedder.consensus_confidence_tokens(ids, mask, temperature=0.2)
+        ),
+        np.asarray(embedder.embed_tokens(ids, mask)),
+    ]
+    ids_r = np.stack([ids] * R)
+    mask_r = np.stack([mask] * R)
+    out.append(
+        np.asarray(embedder.consensus_confidence_tokens_many(ids_r, mask_r))
+    )
+    return out
+
+
+def test_aot_warmup_zero_specializations_under_mixed_load():
+    embedder = make_embedder()
+    timings = embedder.aot_warmup([(N, S)], r_buckets=[R])
+    # both vote variants + embed bucket + grouped R bucket
+    labels = [label for label, _ in timings]
+    assert len(labels) == 4, labels
+    stats0 = embedder.jit_stats()
+    assert stats0["aot_buckets"] == 4
+
+    got = mixed_load(embedder)
+
+    stats1 = embedder.jit_stats()
+    assert stats1["aot_buckets"] == 4
+    # THE acceptance: post-warmup mixed load at warmed buckets creates
+    # zero jit specializations (delta per entry point, caches are global)
+    assert stats1["specializations"] == stats0["specializations"], (
+        stats0, stats1,
+    )
+
+    # AOT executables compute the same thing the lazy-jit path does
+    ref = mixed_load(make_embedder())
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32), atol=1e-5
+        )
+
+
+def test_aot_warmup_idempotent_and_dtype_guarded():
+    embedder = make_embedder()
+    embedder.aot_warmup([(N, S)], r_buckets=[R])
+    # warming the same bucket again compiles nothing new
+    assert embedder.aot_warmup([(N, S)], r_buckets=[R]) == []
+    assert embedder.jit_stats()["aot_buckets"] == 4
+    # non-int32 inputs must MISS the table (executables were lowered for
+    # int32 avals; a table hit would raise inside the compiled call)
+    assert embedder._aot_lookup(("vote1", N, S, True),
+                                np.zeros((N, S), np.int64),
+                                np.ones((N, S), np.int32)) is None
+
+
+def test_aot_warmup_refuses_non_default_dispatch():
+    embedder = make_embedder()
+    embedder.batch_multiple = 2  # dp-padded batches need the jit path
+    assert not embedder._aot_ready()
+    with pytest.raises(RuntimeError, match="single-device"):
+        embedder.aot_warmup([(N, S)])
+
+
+def test_serve_warmup_routes_to_aot(caplog):
+    from llm_weighted_consensus_tpu.serve.__main__ import _warmup_embedder
+
+    embedder = make_embedder()
+    with caplog.at_level(logging.INFO, logger="lwc.serve"):
+        _warmup_embedder(embedder, [(N, S)], r_buckets=[R], aot=True)
+    assert embedder.jit_stats()["aot_buckets"] == 4
+    aot_lines = [r for r in caplog.records if "warmup AOT" in r.msg]
+    assert len(aot_lines) == 4
+
+    # WARMUP_AOT=0 keeps the dispatch-loop warmup: table stays empty
+    embedder2 = make_embedder()
+    _warmup_embedder(embedder2, [(N, S)], r_buckets=[R], aot=False)
+    assert embedder2.jit_stats()["aot_buckets"] == 0

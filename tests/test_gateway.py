@@ -353,7 +353,9 @@ def test_warmup_compiles_configured_shapes():
     embedder.consensus_confidence_tokens = lambda ids, mask, *a: (
         calls.append((ids.shape, mask.shape)) or real(ids, mask, *a)
     )
-    _warmup_embedder(embedder, [(4, 16), (6, 30), (6, 32)])
+    # aot=False pins the dispatch-loop warmup (the WARMUP_AOT=0 /
+    # mesh-sharded route); the AOT default is pinned in tests/test_aot.py
+    _warmup_embedder(embedder, [(4, 16), (6, 30), (6, 32)], aot=False)
     # S snaps to the serving seq bucket (30 -> 32); specs that collapse
     # to the same compiled shape dedup (6x30 == 6x32 -> one dispatch)
     assert calls == [((4, 16), (4, 16)), ((6, 32), (6, 32))]
@@ -387,7 +389,9 @@ def test_warmup_r_compiles_grouped_path():
     embedder.consensus_confidence_tokens_many = lambda ids, mask, *a: (
         many_calls.append(ids.shape) or real_many(ids, mask, *a)
     )
-    _warmup_embedder(embedder, [(4, 16)], r_buckets=[1, 2])
+    # aot=False: the grouped DISPATCH warm (AOT grouped buckets are
+    # pinned in tests/test_aot.py)
+    _warmup_embedder(embedder, [(4, 16)], r_buckets=[1, 2], aot=False)
     # R=1 rides the single-request path (already warmed); only R=2 hits
     # the grouped dispatch
     assert many_calls == [(2, 4, 16)]

@@ -338,6 +338,126 @@ def test_merge_streams_propagates_pump_crash():
     go(main())
 
 
+def test_merge_streams_drains_queued_items_before_pump_crash():
+    # ordering invariant (score.py merge loop: drain queue FIRST, then
+    # propagate pump exceptions): items a crashing judge enqueued before
+    # its raw non-ChatError failure must ALL surface before the crash
+    # propagates — a mid-stream programming error may fail the request but
+    # must never swallow chunks that already arrived
+    from llm_weighted_consensus_tpu.clients.score import merge_streams
+
+    async def boom():
+        yield 1
+        yield 2
+        yield 3
+        raise RuntimeError("late crash")
+
+    async def main():
+        items = []
+        with pytest.raises(RuntimeError, match="late crash"):
+            async for item in merge_streams([boom()]):
+                items.append(item)
+        # every pre-crash item surfaced, in order, before the raise
+        assert items == [1, 2, 3]
+
+    go(main())
+
+
+def test_poison_judge_raw_connect_error_is_isolated():
+    # a transport that raises a RAW exception (not a ChatError) at connect
+    # time: the chat-client wrapper turns it into a TransportError item and
+    # the per-judge wrapper turns that into an error choice — the raw
+    # exception is unreachable at the merge layer and the surviving judge
+    # decides alone
+    model = two_judge_model()
+    keys = ballot_keys(3)
+    order = [llm.base.model for llm in model.llms]
+    scripts = {
+        "judge-a": Script(connect_error=RuntimeError("poison: raw, not ChatError")),
+        "judge-b": judge_script(keys[2]),
+    }
+    client, _ = make_client([scripts[m] for m in order])
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert not any(isinstance(i, (ScoreError, Exception)) for i in items)
+    error_choices = [
+        c for item in items for c in item.choices if c.error is not None
+    ]
+    assert len(error_choices) == 1
+    assert error_choices[0].finish_reason == "error"
+    # nested taxonomy proves the wrapping chain: raw -> transport -> chat
+    # -> score, never a bare exception
+    assert "poison" in str(error_choices[0].error.message)
+    assert "transport" in str(error_choices[0].error.message)
+    final = items[-1]
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    assert cand[2].confidence == Decimal(1)
+
+
+def test_mid_stream_raw_error_yields_queued_chunks_before_failure_frame():
+    # a judge stream that dies with a RAW exception MID-stream (after
+    # content already arrived): the content chunk that preceded the
+    # failure must still be yielded — carrying the failure marker — before
+    # the final frame, and the healthy judge still decides the consensus
+    from fakes import sse_frames
+
+    class PoisonMidStream(FakeTransport):
+        """First judge's byte stream raises raw RuntimeError after the
+        first content frame; later requests serve their script intact."""
+
+        def __init__(self, scripts):
+            super().__init__(scripts)
+            self._poisoned = False
+
+        async def post_sse(self, url, headers, body):
+            resp = await super().post_sse(url, headers, body)
+            if self._poisoned:
+                return resp
+            self._poisoned = True
+            first_frame = sse_frames(
+                [chunk_obj("I pick ", model="up-model")]
+            )
+
+            class _Poison(type(resp)):
+                async def byte_stream(self):
+                    yield first_frame
+                    raise RuntimeError("mid-stream poison")
+
+            return _Poison()
+
+    model = two_judge_model()
+    keys = ballot_keys(3)
+    transport = PoisonMidStream(
+        [Script([]), judge_script(keys[0])]  # poison ignores its script
+    )
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "key")], backoff=FAST
+    )
+    client = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+    )
+    items = go(collect(client, score_params(TEXTS, inline_model_json(model))))
+    assert not any(isinstance(i, (ScoreError, Exception)) for i in items)
+    # the pre-failure content chunk surfaced, with the error attached to it
+    poisoned = [
+        (item, c)
+        for item in items
+        for c in item.choices
+        if c.error is not None and "mid-stream poison" in str(c.error.message)
+    ]
+    assert poisoned
+    chunk, choice = poisoned[0]
+    assert choice.delta.content == "I pick "  # queued content not swallowed
+    # the failure frame does not end the request: final tally follows and
+    # the healthy judge decides alone
+    final = items[-1]
+    assert final.weight_data is not None
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    assert cand[0].confidence == Decimal(1)
+
+
 # -- request validation -------------------------------------------------------
 
 

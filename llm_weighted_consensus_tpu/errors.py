@@ -65,6 +65,29 @@ def to_response_error(err) -> ResponseError:
     return ResponseError(code=500, message="internal error")
 
 
+class OverloadedError(StatusError):
+    """Load shed (503): the gateway's admission gate or the device
+    batcher's bounded queue refused the work.  ``shed_reason`` is the
+    machine-readable discriminator clients back off on (the gateway
+    middleware renders the same body shape plus a ``Retry-After``
+    header — resilience/admission.py)."""
+
+    def __init__(
+        self,
+        shed_reason: str,
+        retry_after_ms: Optional[float] = None,
+    ):
+        super().__init__(f"overloaded: {shed_reason}")
+        self.shed_reason = shed_reason
+        self.retry_after_ms = retry_after_ms
+
+    def status(self) -> int:
+        return 503
+
+    def message(self):
+        return {"kind": "overloaded", "shed_reason": self.shed_reason}
+
+
 # ---------------------------------------------------------------------------
 # Chat client errors (reference src/chat/completions/error.rs)
 # ---------------------------------------------------------------------------

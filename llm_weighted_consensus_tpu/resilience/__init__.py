@@ -26,7 +26,15 @@ one its own module with a pure, clock-injectable core:
 * ``faults``    — a deterministic, seeded fault-injection ``Transport``
   (connect refusal, 5xx, stalls, malformed SSE, truncation) so every
   degradation path above is exercised in tests instead of discovered in
-  production.
+  production;
+* ``admission`` — overload protection at the gateway door: a hard
+  in-flight cap plus an AIMD/gradient adaptive limit, shedding excess
+  work with ``503 + Retry-After + shed_reason`` instead of queueing it
+  to death (Netflix concurrency-limits / SRE load-shedding pattern);
+* ``watchdog``  — a device dispatch watchdog (begin/end brackets around
+  every batched TPU dispatch + a monitor thread): a hung PJRT dispatch
+  marks the device unhealthy — readiness flips, admission sheds
+  device-dependent work, and a configured CPU fallback takes over.
 
 Everything is opt-in: a ``ResiliencePolicy`` of ``None`` (the default
 everywhere) preserves pre-resilience behavior byte-for-byte.  Pure-core
@@ -38,12 +46,14 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from .admission import AdmissionConfig, AdmissionController  # noqa: F401
 from .breaker import BreakerConfig, BreakerRegistry, CircuitBreaker  # noqa: F401
 from .budget import RetryBudget, current_retry_budget  # noqa: F401
 from .deadline import Deadline, current_deadline  # noqa: F401
 from .faults import FaultInjectionTransport, FaultPlan  # noqa: F401
 from .hedge import HedgePolicy, LatencyTracker  # noqa: F401
 from .quorum import QuorumTracker  # noqa: F401
+from .watchdog import DeviceWatchdog  # noqa: F401
 
 
 @dataclass
@@ -84,10 +94,13 @@ class ResiliencePolicy:
 
 
 __all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
     "BreakerConfig",
     "BreakerRegistry",
     "CircuitBreaker",
     "Deadline",
+    "DeviceWatchdog",
     "FaultInjectionTransport",
     "FaultPlan",
     "HedgePolicy",

@@ -1,0 +1,217 @@
+"""Admission control: shed excess work at the door, not in the queue.
+
+The serving edge funnels every request's device work through one PJRT
+queue, so overload does not degrade gracefully on its own — it turns
+into unbounded queue growth and blown deadlines for *everyone*.  The
+standard SRE answer (Netflix concurrency-limits, the Google SRE book's
+load-shedding chapter) is to bound concurrency at admission and reject
+the excess early with a retryable 503, keeping the latency of the work
+actually admitted close to its unloaded latency.
+
+``AdmissionController`` is the pure core (clock-injectable, no aiohttp
+at module scope): a hard ``max_inflight`` cap, plus an optional
+AIMD/gradient limit that tracks observed latency against a slowly
+drifting baseline — when latency inflates past
+``latency_factor x baseline`` the limit decays multiplicatively, and
+while the pipe is full-but-healthy it recovers additively.  A
+``max_inflight`` of 0 disables shedding entirely; the controller then
+only *tracks* in-flight work (the gauge the drain path needs), which
+preserves pre-admission behavior byte for byte.
+
+``admission_middleware`` is the aiohttp wiring: health/metrics probes
+are exempt, everything else either acquires a slot or gets
+``503 + Retry-After + {"kind": "overloaded", "shed_reason": ...}``.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+# probes must keep answering while the service sheds or drains — a load
+# balancer that cannot read /readyz cannot take us out of rotation
+EXEMPT_PATHS = frozenset({"/healthz", "/livez", "/readyz", "/metrics"})
+
+# endpoints whose handler requires a live device forward: when the
+# watchdog marks the device unhealthy and no CPU fallback is configured,
+# only THESE shed — host-only endpoints (chat/score fan-out, archive)
+# keep serving
+DEVICE_PATHS = frozenset({"/embeddings", "/consensus"})
+
+
+@dataclass
+class AdmissionConfig:
+    """Knobs, env-mapped in serve/config.py (``ADMISSION_*``)."""
+
+    max_inflight: int = 0  # hard concurrency cap; 0 = no shedding
+    max_queue_depth: int = 0  # DeviceBatcher queue bound; 0 = unbounded
+    adaptive: bool = False  # AIMD limit under the hard cap
+    min_limit: int = 2  # adaptive floor
+    latency_factor: float = 2.0  # congestion when ms > factor * baseline
+    retry_after_ms: float = 1000.0  # Retry-After hint on sheds
+
+
+class AdmissionController:
+    """In-flight accounting + the shed decision.  Single-threaded by
+    contract (mutated only from the event loop), like every counter
+    object in this package."""
+
+    def __init__(
+        self,
+        config: AdmissionConfig,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        device_gate: Optional[Callable[[], Optional[str]]] = None,
+    ) -> None:
+        self.config = config
+        self.clock = clock
+        # extra shed policy for device-dependent work (wired to the
+        # watchdog): returns a shed reason, or None to admit
+        self.device_gate = device_gate
+        self.inflight = 0
+        self.draining = False
+        # the adaptive limit lives under the hard cap; without the
+        # adaptive mode it just mirrors max_inflight
+        self.limit = float(config.max_inflight)
+        self._baseline_ms: Optional[float] = None
+        self._last_decrease = -math.inf
+        self.admitted = 0
+        self.shed: dict = {}
+
+    # -- the decision ---------------------------------------------------------
+
+    def try_acquire(self, device_work: bool = False) -> Optional[str]:
+        """Admit (returns None, slot held until ``release``) or the shed
+        reason."""
+        if self.draining:
+            return self._shed("draining")
+        if self.device_gate is not None and device_work:
+            reason = self.device_gate()
+            if reason is not None:
+                return self._shed(reason)
+        cap = self.config.max_inflight
+        if cap > 0:
+            effective = (
+                max(self.config.min_limit, int(self.limit))
+                if self.config.adaptive
+                else cap
+            )
+            if self.inflight >= min(cap, effective):
+                return self._shed("inflight_limit")
+        self.inflight += 1
+        self.admitted += 1
+        return None
+
+    def release(self, latency_ms: float, *, error: bool = False) -> None:
+        self.inflight = max(0, self.inflight - 1)
+        if self.config.adaptive and self.config.max_inflight > 0:
+            self._adapt(latency_ms, error)
+
+    def _shed(self, reason: str) -> str:
+        self.shed[reason] = self.shed.get(reason, 0) + 1
+        return reason
+
+    # -- the AIMD/gradient limit ----------------------------------------------
+
+    def _adapt(self, ms: float, error: bool) -> None:
+        """Latency-gradient AIMD (concurrency-limits' Gradient idea in
+        its simplest honest form): the baseline is a decayed minimum of
+        observed latency — it snaps down instantly and drifts up 0.1%
+        per sample so a regime change (bigger model, slower link) is
+        eventually accepted as the new normal.  Congestion = a sample
+        beyond ``latency_factor x baseline``: multiplicative decrease,
+        at most once per baseline-interval so one slow burst doesn't
+        collapse the limit to the floor.  Full-but-healthy = additive
+        increase (+1/limit per sample, the classic probe)."""
+        if self._baseline_ms is None:
+            self._baseline_ms = ms
+        else:
+            self._baseline_ms = min(ms, self._baseline_ms * 1.001)
+        congested = error or ms > self._baseline_ms * max(
+            1.0, self.config.latency_factor
+        )
+        now = self.clock()
+        if congested:
+            # decrease cooldown: the in-flight samples that started
+            # before the last decrease still carry the old congestion
+            hold_sec = max(0.05, self._baseline_ms / 1e3)
+            if now - self._last_decrease >= hold_sec:
+                self.limit = max(self.config.min_limit, self.limit * 0.9)
+                self._last_decrease = now
+        elif self.inflight >= int(self.limit) - 1:
+            self.limit = min(
+                float(self.config.max_inflight),
+                self.limit + 1.0 / max(self.limit, 1.0),
+            )
+
+    # -- observability --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        out = {
+            "inflight": self.inflight,
+            "admitted": self.admitted,
+            "shed": dict(self.shed),
+            "draining": self.draining,
+            "max_inflight": self.config.max_inflight,
+            "max_queue_depth": self.config.max_queue_depth,
+        }
+        if self.config.adaptive:
+            out["limit"] = round(self.limit, 2)
+            if self._baseline_ms is not None:
+                out["baseline_ms"] = round(self._baseline_ms, 2)
+        return out
+
+
+def shed_response(reason: str, retry_after_ms: float):
+    """The uniform 503 shed response: ``Retry-After`` header + the
+    ``{code, message}`` envelope with a machine-readable ``shed_reason``
+    (same body shape OverloadedError renders on the batcher path)."""
+    from aiohttp import web
+
+    from ..utils import jsonutil
+
+    return web.Response(
+        status=503,
+        headers={
+            "Retry-After": str(max(1, math.ceil(retry_after_ms / 1000.0)))
+        },
+        text=jsonutil.dumps(
+            {
+                "code": 503,
+                "message": {"kind": "overloaded", "shed_reason": reason},
+            }
+        ),
+        content_type="application/json",
+    )
+
+
+def admission_middleware(admission: AdmissionController):
+    """Outer-edge gate: every non-probe request either holds an
+    admission slot for its whole lifetime (streams included — the
+    handler returns only after the last SSE frame) or is shed before
+    any work happens."""
+    from aiohttp import web
+
+    @web.middleware
+    async def _mw(request, handler):
+        if request.path in EXEMPT_PATHS:
+            return await handler(request)
+        reason = admission.try_acquire(
+            device_work=request.path in DEVICE_PATHS
+        )
+        if reason is not None:
+            return shed_response(reason, admission.config.retry_after_ms)
+        t0 = admission.clock()
+        error = True
+        try:
+            resp = await handler(request)
+            error = resp.status >= 500
+            return resp
+        finally:
+            admission.release(
+                (admission.clock() - t0) * 1e3, error=error
+            )
+
+    return _mw

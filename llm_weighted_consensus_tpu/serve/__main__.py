@@ -21,7 +21,7 @@ from ..clients.multichat import MultichatClient
 from ..clients.score import ScoreClient
 from ..weights import WeightFetchers
 from .config import Config, enable_compile_cache, load_dotenv
-from .gateway import _parse_error_response, build_app
+from .gateway import LIFECYCLE_KEY, _parse_error_response, build_app
 
 FAKE_PORT = 5990
 
@@ -181,7 +181,18 @@ def _learn_handler(store, embedder, tables, lock):
 
 async def _fake_upstream(request: web.Request) -> web.StreamResponse:
     """A scripted judge provider: finds the ballot in the system prompt and
-    votes for a random key; plain chat otherwise."""
+    votes for a random key; plain chat otherwise.
+
+    ``FAKE_UPSTREAM_DELAY_MS`` (process env, read per request) adds a
+    judge-latency sleep before the first frame, so load/drain scenarios
+    (bench_http.py --overload, the chaos SIGTERM drill) exercise requests
+    that HOLD their admission slot for a realistic interval instead of
+    completing in microseconds."""
+    import os
+
+    delay_ms = float(os.environ.get("FAKE_UPSTREAM_DELAY_MS", "0") or 0.0)
+    if delay_ms > 0:
+        await asyncio.sleep(delay_ms / 1e3)
     body = await request.json()
     content = "This is a fake upstream completion."
     for message in reversed(body.get("messages", [])):
@@ -602,6 +613,55 @@ def _warmup_embedder(
             )
 
 
+def _build_cpu_fallback(config: Config, fake_upstream: bool):
+    """(embedder, device-context factory) for DEVICE_WATCHDOG_CPU_FALLBACK:
+    a CPU twin of the serving embedder, built at startup (weights reload
+    from the same checkpoint) while the device is still healthy.  Mesh
+    flags and int8 quantization are stripped — the fallback's whole job
+    is to exist off the wedged device, not to be fast — and every
+    dispatch through it runs under ``jax.default_device(cpu)`` so its
+    computations never queue behind the hung dispatch.  Failure to build
+    one degrades to watchdog-without-fallback (device endpoints shed
+    while unhealthy) rather than failing startup."""
+    import dataclasses
+    import logging
+
+    log = logging.getLogger("lwc.serve")
+    try:
+        import jax
+
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            fallback = build_embedder(
+                dataclasses.replace(
+                    config,
+                    mesh_dp=None,
+                    mesh_tp=1,
+                    mesh_sp=None,
+                    embedder_quantize="none",
+                ),
+                allow_synthetic=fake_upstream,
+            )
+    except Exception:
+        log.warning(
+            "DEVICE_WATCHDOG_CPU_FALLBACK: could not build the CPU "
+            "fallback embedder; device endpoints will shed while the "
+            "watchdog holds the device unhealthy",
+            exc_info=True,
+        )
+        return None, None
+
+    def fallback_context():
+        import jax
+
+        return jax.default_device(jax.devices("cpu")[0])
+
+    log.info(
+        "device watchdog CPU fallback ready (%s)", config.embedder_model
+    )
+    return fallback, fallback_context
+
+
 def build_service(
     config: Config,
     fake_upstream: bool = False,
@@ -692,6 +752,27 @@ def build_service(
                 config.score_cache_ttl_sec,
                 config.score_cache_embed_max_bytes,
             )
+    # device watchdog (DEVICE_WATCHDOG_MILLIS > 0): brackets every
+    # batched dispatch; a hung PJRT call flips readiness and — with the
+    # CPU fallback built below — reroutes device work off the chip
+    watchdog = None
+    if config.device_watchdog_millis > 0:
+        from ..resilience import DeviceWatchdog
+
+        watchdog = DeviceWatchdog(
+            config.device_watchdog_millis,
+            interval_ms=config.device_watchdog_interval_millis,
+        )
+    fallback_embedder = None
+    fallback_context = None
+    if (
+        watchdog is not None
+        and config.device_watchdog_cpu_fallback
+        and embedder is not None
+    ):
+        fallback_embedder, fallback_context = _build_cpu_fallback(
+            config, fake_upstream
+        )
     batcher = None
     if embedder is not None:
         from .batcher import DeviceBatcher
@@ -704,7 +785,60 @@ def build_service(
             pipeline_depth=config.batch_pipeline,
             max_rows=config.batch_max_rows,
             embed_cache=embed_cache,
+            max_queue_depth=config.admission_max_queue_depth,
+            watchdog=watchdog,
+            fallback_embedder=fallback_embedder,
+            fallback_context=fallback_context,
         )
+    if watchdog is not None:
+        import logging
+
+        _log = logging.getLogger("lwc.serve")
+        _batcher = batcher
+
+        def _on_trip(kind: str, overdue_ms: float) -> None:
+            _log.error(
+                "device watchdog TRIPPED: %s dispatch overdue after "
+                "%.0f ms%s",
+                kind,
+                overdue_ms,
+                (
+                    "; routing device work to the CPU fallback"
+                    if _batcher is not None
+                    and _batcher.fallback_embedder is not None
+                    else "; device endpoints will shed until it completes"
+                ),
+            )
+            if _batcher is not None:
+                _batcher.use_fallback(True)
+
+        def _on_recover() -> None:
+            _log.warning(
+                "device watchdog recovered: the overdue dispatch "
+                "completed, device traffic resumes"
+            )
+            if _batcher is not None:
+                _batcher.use_fallback(False)
+
+        watchdog.on_trip = _on_trip
+        watchdog.on_recover = _on_recover
+        watchdog.start()
+
+    # admission gate: always present (with every knob 0 it never sheds,
+    # it only tracks in-flight work for the drain path); device-
+    # dependent endpoints additionally shed while the watchdog holds
+    # the device unhealthy and no CPU fallback can absorb the work
+    from ..resilience import AdmissionController
+
+    def _device_gate():
+        if watchdog is not None and not watchdog.healthy():
+            if batcher is None or batcher.fallback_embedder is None:
+                return "device_unhealthy"
+        return None
+
+    admission = AdmissionController(
+        config.admission_config(), device_gate=_device_gate
+    )
     weight_fetchers = WeightFetchers()
     tables = None
     if embedder is not None:
@@ -776,6 +910,18 @@ def build_service(
             lambda result, params: store.put_multichat(result),
             stream_fold=fold(multichat_response.ChatCompletion),
         )
+    # the drain/readiness state machine: SIGTERM flips /readyz, stops
+    # admission, drains in-flight streams + the batcher queue (bounded
+    # by DRAIN_TIMEOUT_MILLIS), flushes the cache disk tier once
+    from .lifecycle import Lifecycle
+
+    lifecycle = Lifecycle(
+        admission=admission,
+        batcher=batcher,
+        caches=(score_cache, embed_cache),
+        watchdog=watchdog,
+        drain_timeout_ms=config.drain_timeout_millis,
+    )
     app = build_app(
         gw_chat,
         gw_score,
@@ -787,6 +933,9 @@ def build_service(
         reranker=reranker,
         resilience=resilience,
         fault_plan=fault_plan,
+        admission=admission,
+        lifecycle=lifecycle,
+        watchdog=watchdog,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables
@@ -827,6 +976,14 @@ def build_service(
         await transport.close()
 
     app.on_cleanup.append(_close_transport)
+    if watchdog is not None:
+        # signal-free shutdowns (tests, embedding into another runner)
+        # must still stop the monitor thread; stop() is idempotent with
+        # the drain path's
+        async def _stop_watchdog(app):
+            watchdog.stop()
+
+        app.on_cleanup.append(_stop_watchdog)
     return app
 
 
@@ -849,14 +1006,36 @@ async def _serve(config: Config, fake_upstream: bool) -> None:
     # runs to completion with no interrupt in flight — asyncio's default
     # handling can fire KeyboardInterrupt INSIDE a cleanup hook and lose
     # whichever snapshot hadn't been written yet
+    import logging
     import signal
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
+    lifecycle = app.get(LIFECYCLE_KEY)
+
+    def _drained(task: asyncio.Task) -> None:
+        if not task.cancelled() and task.exception() is not None:
+            logging.getLogger("lwc.serve").error(
+                "graceful drain failed; shutting down anyway",
+                exc_info=task.exception(),
+            )
+        stop.set()
+
+    def _on_signal() -> None:
+        if lifecycle is None:
+            stop.set()
+            return
+        # graceful drain: /readyz flips and admission stops BEFORE the
+        # listener closes (runner.cleanup runs only after the drain
+        # task completes and sets the stop event).  begin_drain is
+        # idempotent — repeated signals join the drain in progress.
+        print("draining (SIGTERM/SIGINT received)...", flush=True)
+        lifecycle.begin_drain().add_done_callback(_drained)
+
     handled = []
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
-            loop.add_signal_handler(sig, stop.set)
+            loop.add_signal_handler(sig, _on_signal)
             handled.append(sig)
         except (NotImplementedError, RuntimeError):
             pass

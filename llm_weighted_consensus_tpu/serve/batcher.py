@@ -45,13 +45,17 @@ import numpy as np
 
 
 class _Item:
-    __slots__ = ("kind", "key", "payload", "future")
+    __slots__ = ("kind", "key", "payload", "future", "deadline")
 
-    def __init__(self, kind, key, payload, future):
+    def __init__(self, kind, key, payload, future, deadline=None):
         self.kind = kind
         self.key = key
         self.payload = payload
         self.future = future
+        # the request's propagated deadline (resilience/deadline.py),
+        # captured at submit so the pre-dispatch shed can drop work
+        # that can no longer finish in time
+        self.deadline = deadline
 
 
 class DeviceBatcher:
@@ -73,9 +77,36 @@ class DeviceBatcher:
         pipeline_depth: int = 2,
         max_rows: int = 512,
         embed_cache=None,
+        max_queue_depth: int = 0,
+        watchdog=None,
+        fallback_embedder=None,
+        fallback_context=None,
     ) -> None:
         self.embedder = embedder
         self.metrics = metrics
+        # bounded queue (ADMISSION_MAX_QUEUE_DEPTH): arrivals beyond
+        # this many pending items fail fast with OverloadedError (503)
+        # instead of growing the queue without limit; 0 = unbounded
+        # (the pre-change behavior)
+        self.max_queue_depth = max(0, int(max_queue_depth))
+        # device watchdog (resilience/watchdog.py): every dispatch is
+        # bracketed begin/end so a hung PJRT call is detected
+        self.watchdog = watchdog
+        # CPU fallback: while the watchdog holds the device unhealthy,
+        # dispatches route to this embedder instead (built against host
+        # params); fallback_context() supplies the jax.default_device
+        # scope so its computations stay off the wedged device
+        self.fallback_embedder = fallback_embedder
+        self.fallback_context = fallback_context
+        self._use_fallback = False
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
+        self.cancelled_items = 0
+        self.fallback_dispatches = 0
+        # per-kind EWMA of dispatch wall time: the deadline shed drops
+        # an item whose remaining budget is below the expected cost
+        # (CoDel-flavored: dead-on-arrival work never reaches the MXU)
+        self._ewma_ms: dict = {}
         # optional per-row embedding memoization (cache/EmbeddingCache):
         # hot rows resolve before the dispatch path, and identical rows
         # in flight collapse onto one device computation
@@ -254,6 +285,34 @@ class DeviceBatcher:
     def close(self) -> None:
         self._executor.shutdown(wait=False)
 
+    # -- overload / lifecycle hooks -------------------------------------------
+
+    def use_fallback(self, active: bool) -> None:
+        """Route dispatches to the CPU fallback embedder (watchdog
+        on_trip) or back to the device (on_recover).  A bare flag read
+        by the dispatch path; no-op without a fallback embedder."""
+        self._use_fallback = bool(active)
+
+    def idle(self) -> bool:
+        """No pending items and no dispatch in flight."""
+        return (
+            not self._pending
+            and not self._inflight
+            and (self._flusher is None or self._flusher.done())
+        )
+
+    async def drain(self, timeout_sec: float) -> bool:
+        """Wait (bounded) for every queued item to dispatch and every
+        dispatch to finish; True = the queue drained clean.  The drain
+        path in serve/lifecycle.py calls this after admission stops —
+        nothing new arrives, so the wait is monotone."""
+        deadline = time.perf_counter() + max(0.0, float(timeout_sec))
+        while not self.idle():
+            if time.perf_counter() >= deadline:
+                return self.idle()
+            await asyncio.sleep(0.005)
+        return True
+
     # -- observability (SURVEY §5 metrics row: "device util") -----------------
 
     def utilization(self, window_sec: float = 60.0) -> dict:
@@ -278,19 +337,53 @@ class DeviceBatcher:
             else 0.0,
             "window_ms": self.window_ms,
             "max_batch": self.max_batch,
+            "max_queue_depth": self.max_queue_depth,
+            "shed_queue_full": self.shed_queue_full,
+            "shed_deadline": self.shed_deadline,
+            "cancelled_items": self.cancelled_items,
+            "fallback_active": self._use_fallback,
+            "fallback_dispatches": self.fallback_dispatches,
         }
 
     # -- internals -----------------------------------------------------------
 
     async def _submit(self, kind, key, payload):
+        if (
+            self.max_queue_depth
+            and len(self._pending) >= self.max_queue_depth
+        ):
+            # fail fast at the door: a queue this deep means every item
+            # behind it would wait out its deadline anyway (satellite
+            # fix for the unbounded deque growth under overload)
+            self.shed_queue_full += 1
+            if self.metrics is not None:
+                self.metrics.observe(
+                    "device:shed:queue_full", 0.0, error=True
+                )
+            from ..errors import OverloadedError
+
+            raise OverloadedError("batcher_queue_full")
+        from ..resilience.deadline import current_deadline
+
         loop = asyncio.get_running_loop()
         future = loop.create_future()
-        self._pending.append(_Item(kind, key, payload, future))
+        self._pending.append(
+            _Item(kind, key, payload, future, current_deadline())
+        )
         if self._flusher is None or self._flusher.done():
             self._flusher = loop.create_task(self._drain())
         elif self._wake is not None:
             self._wake.set()  # unpark a flusher waiting on in-flight work
-        return await future
+        try:
+            return await future
+        except BaseException:
+            # the caller is gone (task cancellation, or a GeneratorExit
+            # thrown into a streaming generator by the client
+            # disconnecting): cancel the item's future so a not-yet-
+            # dispatched item is dropped from its group instead of
+            # burning device time on work nobody will read
+            future.cancel()
+            raise
 
     async def _drain(self) -> None:
         loop = asyncio.get_running_loop()
@@ -310,6 +403,12 @@ class DeviceBatcher:
                     # bounded pipelining: block here (arrivals keep
                     # appending to _pending) until a dispatch slot frees
                     await self._sem.acquire()
+                    # shed AFTER the slot wait — that queueing delay is
+                    # exactly where deadlines die under overload
+                    group = self._shed_group(group)
+                    if not group:
+                        self._sem.release()
+                        continue
                     task = loop.create_task(self._run_group(loop, group))
                     inflight.add(task)
                     task.add_done_callback(inflight.discard)
@@ -328,10 +427,50 @@ class DeviceBatcher:
                 finally:
                     waker.cancel()
 
+    def _shed_group(self, group: list) -> list:
+        """Items still worth dispatching: drops items whose caller
+        already cancelled (client disconnect), and fails items whose
+        propagated deadline is expired — or has less budget left than
+        this kind's warm dispatch-time estimate — with 504 (CoDel-style:
+        dead work is cheapest to drop the moment before it costs MXU
+        time)."""
+        live = []
+        for item in group:
+            if item.future.done():
+                # cancelled by a departed caller (_submit's except path)
+                self.cancelled_items += 1
+                continue
+            deadline = item.deadline
+            if deadline is not None:
+                estimate = self._ewma_ms.get(item.kind)
+                doomed = deadline.expired() or (
+                    estimate is not None
+                    and deadline.remaining() * 1e3 < estimate
+                )
+                if doomed:
+                    from ..errors import DeadlineExceededError
+
+                    item.future.set_exception(
+                        DeadlineExceededError("shed before device dispatch")
+                    )
+                    self.shed_deadline += 1
+                    if self.metrics is not None:
+                        self.metrics.observe(
+                            "device:shed:deadline", 0.0, error=True
+                        )
+                    continue
+            live.append(item)
+        return live
+
     async def _run_group(self, loop, group) -> None:
         t0 = time.perf_counter()
         token = object()
         self._inflight[token] = t0
+        wd_token = (
+            self.watchdog.begin(group[0].kind)
+            if self.watchdog is not None
+            else None
+        )
         try:
             results = await loop.run_in_executor(
                 self._executor, self._dispatch, group
@@ -347,6 +486,8 @@ class DeviceBatcher:
                     item.future.set_result(result)
             self._observe(group, t0, token, error=False)
         finally:
+            if wd_token is not None:
+                self.watchdog.end(wd_token)
             self._sem.release()
 
     def _observe(self, group, t0, token, *, error: bool) -> None:
@@ -358,6 +499,13 @@ class DeviceBatcher:
         self._busy.append((t0, end))
         self._dispatches += 1
         self._items += len(group)
+        if not error:
+            # warm per-kind dispatch-time estimate for the deadline shed
+            ms = (end - t0) * 1e3
+            prev = self._ewma_ms.get(group[0].kind)
+            self._ewma_ms[group[0].kind] = (
+                ms if prev is None else 0.8 * prev + 0.2 * ms
+            )
         if self.metrics is not None:
             self.metrics.observe(
                 f"device:batch:{group[0].kind}",
@@ -442,9 +590,19 @@ class DeviceBatcher:
     # -- dispatch implementations (device thread) ------------------------------
 
     def _dispatch(self, group: list) -> list:
-        return getattr(self, "_dispatch_" + group[0].kind)(group)
+        fn = getattr(self, "_dispatch_" + group[0].kind)
+        if self._use_fallback and self.fallback_embedder is not None:
+            self.fallback_dispatches += 1
+            if self.fallback_context is not None:
+                # jax.default_device scope: the fallback's computations
+                # must stage on the CPU, never queue behind the wedged
+                # device dispatch the watchdog tripped on
+                with self.fallback_context():
+                    return fn(group, self.fallback_embedder)
+            return fn(group, self.fallback_embedder)
+        return fn(group, self.embedder)
 
-    def _dispatch_embed(self, group: list) -> list:
+    def _dispatch_embed(self, group: list, embedder) -> list:
         max_tokens = group[0].payload[1]
         texts: list = []
         counts = []
@@ -452,8 +610,8 @@ class DeviceBatcher:
             t, _ = item.payload
             texts.extend(t)
             counts.append(len(t))
-        ids, mask = self.embedder.tokenize(texts, max_tokens)
-        emb = self.embedder.embed_tokens(ids, mask)
+        ids, mask = embedder.tokenize(texts, max_tokens)
+        emb = embedder.embed_tokens(ids, mask)
         tokens = mask.sum(axis=1)
         out = []
         start = 0
@@ -470,32 +628,32 @@ class DeviceBatcher:
             start += count
         return out
 
-    def _dispatch_consensus(self, group: list) -> list:
+    def _dispatch_consensus(self, group: list, embedder) -> list:
         texts0, temperature = group[0].payload
         n = len(texts0)
         if len(group) == 1:
-            ids, mask = self.embedder.tokenize(texts0)
+            ids, mask = embedder.tokenize(texts0)
             conf = np.asarray(
-                self.embedder.consensus_confidence_tokens(
+                embedder.consensus_confidence_tokens(
                     ids, mask, temperature
                 )
             )
             return [(conf, int(mask.sum()))]
         all_texts = [t for item in group for t in item.payload[0]]
-        ids, mask = self.embedder.tokenize(all_texts)
+        ids, mask = embedder.tokenize(all_texts)
         r = len(group)
         conf = np.asarray(
-            self.embedder.consensus_confidence_tokens_many(
+            embedder.consensus_confidence_tokens_many(
                 ids.reshape(r, n, -1), mask.reshape(r, n, -1), temperature
             )
         )
         tokens = mask.reshape(r, n, -1).sum(axis=(1, 2))
         return [(conf[i], int(tokens[i])) for i in range(r)]
 
-    def _dispatch_stream(self, group: list) -> list:
+    def _dispatch_stream(self, group: list, embedder) -> list:
         if len(group) == 1:
             text, buf, valid, position, temperature, want = group[0].payload
-            out_buf, out_valid, conf = self.embedder.stream_vote_update(
+            out_buf, out_valid, conf = embedder.stream_vote_update(
                 text, buf, valid, position, temperature
             )
             # fetch here, on the device thread — a device-resident conf
@@ -508,7 +666,7 @@ class DeviceBatcher:
         positions = [item.payload[3] for item in group]
         temperature = group[0].payload[4]
         wants = [item.payload[5] for item in group]
-        out_bufs, out_valids, confs = self.embedder.stream_vote_update_many(
+        out_bufs, out_valids, confs = embedder.stream_vote_update_many(
             texts, bufs, valids, positions, temperature
         )
         # fetch ALL wanted confidences in ONE transfer here: every stream

@@ -166,6 +166,49 @@ byte for byte):
 
 Resilience counters + breaker states surface as the ``resilience``
 section of ``GET /metrics``.
+
+Overload & lifecycle (resilience/admission.py, resilience/watchdog.py,
+serve/lifecycle.py; all opt-in except graceful drain, which only changes
+shutdown):
+
+* ``ADMISSION_MAX_INFLIGHT`` — hard cap on concurrently admitted
+  requests; excess work is shed at the gateway door with
+  ``503 + Retry-After`` and a ``shed_reason`` body instead of queueing.
+  ``0`` (the default) disables shedding — the admission gate then only
+  tracks in-flight work (the gauge the drain path uses).
+* ``ADMISSION_MAX_QUEUE_DEPTH`` — bound on the device batcher's pending
+  queue: arrivals beyond it fail fast with 503
+  (``shed_reason: batcher_queue_full``).  ``0`` = unbounded.
+* ``ADMISSION_ADAPTIVE`` — ``1`` enables the AIMD/gradient concurrency
+  limit under the hard cap (Netflix concurrency-limits style): observed
+  latency beyond ``ADMISSION_LATENCY_FACTOR`` x a drifting baseline
+  decays the limit multiplicatively; a full-but-healthy pipe recovers
+  it additively.  Requires ``ADMISSION_MAX_INFLIGHT`` > 0.
+* ``ADMISSION_MIN_LIMIT`` / ``ADMISSION_LATENCY_FACTOR`` /
+  ``ADMISSION_RETRY_AFTER_MILLIS`` — adaptive floor, the congestion
+  threshold multiplier (> 1), and the Retry-After hint on sheds.
+  Defaults 2 / 2.0 / 1000.
+* ``DRAIN_TIMEOUT_MILLIS`` — SIGTERM/SIGINT graceful-drain budget:
+  ``/readyz`` flips to 503, new work sheds (``shed_reason: draining``),
+  in-flight streams finish to their ``[DONE]`` and the batcher queue
+  empties, the cache disk tier is flushed exactly once, then exit 0.
+  Default 10000.
+* ``DEVICE_WATCHDOG_MILLIS`` — a device dispatch exceeding this marks
+  the device unhealthy (hung PJRT / wedged tunnel): ``/readyz`` flips
+  and admission sheds device-dependent endpoints
+  (``shed_reason: device_unhealthy``) until the dispatch completes.
+  ``0`` (the default) disables the watchdog.
+* ``DEVICE_WATCHDOG_INTERVAL_MILLIS`` — monitor-thread check period;
+  ``0`` = auto (a quarter of the timeout).
+* ``DEVICE_WATCHDOG_CPU_FALLBACK`` — ``1`` builds a CPU twin of the
+  embedder at startup and routes embed/consensus dispatches to it while
+  the device is unhealthy (degraded but alive beats shedding).
+  Requires ``DEVICE_WATCHDOG_MILLIS`` > 0.
+
+Shed/drain/watchdog counters and the inflight/queue-depth gauges
+surface as the ``admission`` / ``device_watchdog`` / ``lifecycle`` /
+``device_batcher`` sections of ``GET /metrics``.  ``/healthz`` remains
+as a deprecated alias of the ``/livez`` + ``/readyz`` split.
 """
 
 from __future__ import annotations
@@ -372,6 +415,21 @@ class Config:
     resilience_quorum: float = 0.0  # 0 = wait for the full panel
     # chaos-run fault injection spec (resilience/faults.py); None = off
     fault_plan: Optional[str] = None
+    # overload protection (resilience/admission.py): hard in-flight cap
+    # (0 = no shedding, gauge only), batcher queue bound (0 = unbounded),
+    # and the AIMD/gradient adaptive limit under the cap
+    admission_max_inflight: int = 0
+    admission_max_queue_depth: int = 0
+    admission_adaptive: bool = False
+    admission_min_limit: int = 2
+    admission_latency_factor: float = 2.0
+    admission_retry_after_millis: float = 1000.0
+    # graceful-drain budget on SIGTERM/SIGINT (serve/lifecycle.py)
+    drain_timeout_millis: float = 10000.0
+    # device dispatch watchdog (resilience/watchdog.py); 0 = off
+    device_watchdog_millis: float = 0.0
+    device_watchdog_interval_millis: float = 0.0  # 0 = auto (timeout/4)
+    device_watchdog_cpu_fallback: bool = False
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -485,6 +543,28 @@ class Config:
             resilience_deadline_millis=get_f("RESILIENCE_DEADLINE_MILLIS", 0),
             resilience_quorum=get_f("RESILIENCE_QUORUM", 0),
             fault_plan=env.get("FAULT_PLAN"),
+            admission_max_inflight=_non_negative_int(
+                env, "ADMISSION_MAX_INFLIGHT", 0
+            ),
+            admission_max_queue_depth=_non_negative_int(
+                env, "ADMISSION_MAX_QUEUE_DEPTH", 0
+            ),
+            admission_adaptive=env_truthy(env.get("ADMISSION_ADAPTIVE", "0")),
+            admission_min_limit=max(
+                1, int(env.get("ADMISSION_MIN_LIMIT", 2))
+            ),
+            admission_latency_factor=get_f("ADMISSION_LATENCY_FACTOR", 2.0),
+            admission_retry_after_millis=get_f(
+                "ADMISSION_RETRY_AFTER_MILLIS", 1000
+            ),
+            drain_timeout_millis=get_f("DRAIN_TIMEOUT_MILLIS", 10000),
+            device_watchdog_millis=get_f("DEVICE_WATCHDOG_MILLIS", 0),
+            device_watchdog_interval_millis=get_f(
+                "DEVICE_WATCHDOG_INTERVAL_MILLIS", 0
+            ),
+            device_watchdog_cpu_fallback=env_truthy(
+                env.get("DEVICE_WATCHDOG_CPU_FALLBACK", "0")
+            ),
         )
         if not 0 <= config.resilience_quorum <= 1:
             raise ValueError(
@@ -495,6 +575,37 @@ class Config:
             raise ValueError(
                 f"RESILIENCE_HEDGE_QUANTILE={config.resilience_hedge_quantile}"
                 " must be a quantile in [0, 1)"
+            )
+        if config.admission_adaptive and config.admission_max_inflight <= 0:
+            raise ValueError(
+                "ADMISSION_ADAPTIVE=1 needs ADMISSION_MAX_INFLIGHT > 0: "
+                "the adaptive limit operates UNDER the hard cap (set e.g. "
+                "ADMISSION_MAX_INFLIGHT=64 ADMISSION_ADAPTIVE=1)"
+            )
+        if config.admission_latency_factor <= 1.0:
+            raise ValueError(
+                f"ADMISSION_LATENCY_FACTOR={config.admission_latency_factor} "
+                "must be > 1 (it multiplies the latency baseline to form "
+                "the congestion threshold)"
+            )
+        if config.drain_timeout_millis < 0:
+            raise ValueError(
+                f"DRAIN_TIMEOUT_MILLIS={config.drain_timeout_millis} "
+                "must be >= 0 (0 = shed immediately, no drain wait)"
+            )
+        if config.device_watchdog_millis < 0:
+            raise ValueError(
+                f"DEVICE_WATCHDOG_MILLIS={config.device_watchdog_millis} "
+                "must be >= 0 (0 = watchdog disabled)"
+            )
+        if (
+            config.device_watchdog_cpu_fallback
+            and config.device_watchdog_millis <= 0
+        ):
+            raise ValueError(
+                "DEVICE_WATCHDOG_CPU_FALLBACK=1 needs "
+                "DEVICE_WATCHDOG_MILLIS > 0: without the watchdog nothing "
+                "ever routes work to the fallback"
             )
         if config.warmup_r and not config.warmup:
             # same loud-failure contract as _parse_warmup: WARMUP_R names
@@ -563,6 +674,22 @@ class Config:
             retry_budget_tokens=self.resilience_retry_budget,
             quorum_fraction=self.resilience_quorum,
             deadline_ms=self.resilience_deadline_millis,
+        )
+
+    def admission_config(self):
+        """The AdmissionConfig for the gateway's admission gate.  Always
+        returns one (unlike resilience_policy): with every knob at 0 the
+        controller never sheds — it only tracks in-flight work, which
+        the drain path needs regardless of overload configuration."""
+        from ..resilience import AdmissionConfig
+
+        return AdmissionConfig(
+            max_inflight=self.admission_max_inflight,
+            max_queue_depth=self.admission_max_queue_depth,
+            adaptive=self.admission_adaptive,
+            min_limit=self.admission_min_limit,
+            latency_factor=self.admission_latency_factor,
+            retry_after_ms=self.admission_retry_after_millis,
         )
 
     def fault_injection_plan(self):

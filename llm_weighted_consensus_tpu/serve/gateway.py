@@ -13,8 +13,18 @@ from typing import Optional
 
 from aiohttp import web
 
-from ..errors import ScoreError, StatusError, to_response_error
-from .metrics import Metrics, middleware, register_resilience
+from ..errors import (
+    OverloadedError,
+    ScoreError,
+    StatusError,
+    to_response_error,
+)
+from .metrics import (
+    Metrics,
+    middleware,
+    register_overload,
+    register_resilience,
+)
 from ..types.chat_request import ChatCompletionCreateParams as ChatParams
 from ..types.embeddings import CreateEmbeddingParams
 from ..types.multichat_request import (
@@ -26,6 +36,8 @@ from ..utils import jsonutil
 METRICS_KEY: web.AppKey = web.AppKey("metrics", Metrics)
 # the serving micro-batcher (present when an embedder is configured)
 BATCHER_KEY: web.AppKey = web.AppKey("batcher", object)
+# the drain/readiness state machine (serve/lifecycle.py), when wired
+LIFECYCLE_KEY: web.AppKey = web.AppKey("lifecycle", object)
 
 DONE = b"data: [DONE]\n\n"
 SSE_HEADERS = {
@@ -35,6 +47,21 @@ SSE_HEADERS = {
 
 
 def _error_response(e: Exception) -> web.Response:
+    if isinstance(e, OverloadedError):
+        # load sheds are retryable by construction — say when (same
+        # header the admission middleware sets on its 503s)
+        import math
+
+        return web.Response(
+            status=503,
+            headers={
+                "Retry-After": str(
+                    max(1, math.ceil((e.retry_after_ms or 1000.0) / 1000.0))
+                )
+            },
+            text=jsonutil.dumps({"code": 503, "message": e.message()}),
+            content_type="application/json",
+        )
     if isinstance(e, StatusError):
         status, message = e.status(), e.message()
         body = jsonutil.dumps(message)
@@ -66,6 +93,16 @@ async def _respond_streaming(request: web.Request, stream) -> web.StreamResponse
                 payload = item.to_json_obj()
             await resp.write(_frame(payload))
         await resp.write(DONE)
+    except (ConnectionResetError, ConnectionError):
+        # the client disconnected mid-stream: nothing left to say to it,
+        # but the abandoned pipeline must be torn down NOW — the finally
+        # below acloses the generator chain, whose cleanup cancels the
+        # upstream judge pumps and any batcher futures this request has
+        # in flight (batcher._submit drops a cancelled item before its
+        # group dispatches — no orphaned device work)
+        metrics = request.app.get(METRICS_KEY)
+        if metrics is not None:
+            metrics.observe("http:client_disconnect", 0.0, error=True)
     finally:
         aclose = getattr(stream, "aclose", None)
         if aclose is not None:
@@ -315,9 +352,13 @@ def build_app(
     embed_cache=None,
     resilience=None,
     fault_plan=None,
+    admission=None,
+    lifecycle=None,
+    watchdog=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
     register_resilience(metrics, resilience, fault_plan)
+    register_overload(metrics, admission, watchdog, lifecycle)
     if embedder is not None and batcher is None:
         from .batcher import DeviceBatcher
 
@@ -327,6 +368,12 @@ def build_app(
             window_ms=batch_window_ms,
             max_batch=batch_max,
             embed_cache=embed_cache,
+            watchdog=watchdog,
+            max_queue_depth=(
+                admission.config.max_queue_depth
+                if admission is not None
+                else 0
+            ),
         )
     # consensus result cache counters (hits/misses/evictions + in-flight
     # collapses) surface as the `score_cache` section of GET /metrics;
@@ -345,10 +392,18 @@ def build_app(
 
         metrics.register_provider("score_cache", _score_cache_stats)
     middlewares = [middleware(metrics)]
+    if admission is not None:
+        # inside metrics (sheds are observable per route), outside the
+        # deadline stamp (shed work should not even start a budget)
+        from ..resilience.admission import admission_middleware
+
+        middlewares.append(admission_middleware(admission))
     if resilience is not None:
         middlewares.append(deadline_middleware(resilience))
     app = web.Application(middlewares=middlewares)
     app[METRICS_KEY] = metrics
+    if lifecycle is not None:
+        app[LIFECYCLE_KEY] = lifecycle
     if batcher is not None:
         app[BATCHER_KEY] = batcher
 
@@ -394,12 +449,19 @@ def build_app(
         )
 
     async def healthz(request):
+        # deprecated alias for the /livez + /readyz split: kept
+        # byte-identical for pre-split probers
         return web.json_response({"ok": True})
 
     async def metrics_handler(request):
         return web.json_response(metrics.snapshot())
 
+    from .lifecycle import health_handlers
+
+    livez, readyz = health_handlers(lifecycle)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/livez", livez)
+    app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", metrics_handler)
     if profile_dir:
         start, stop = _profile_handlers(profile_dir)

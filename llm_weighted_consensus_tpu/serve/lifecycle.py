@@ -1,0 +1,152 @@
+"""Serving lifecycle: liveness/readiness split + graceful drain.
+
+The reference binary dies however tokio dies; a serving edge behind a
+load balancer needs the standard Kubernetes-shaped lifecycle instead:
+
+* ``GET /livez``  — process liveness: 200 as long as the event loop
+  answers.  Restarting on livez failure is the supervisor's job.
+* ``GET /readyz`` — traffic readiness: 200 only while the service is
+  ``READY`` *and* the device watchdog (when configured) holds the
+  device healthy.  Flips to 503 the instant a drain begins or the
+  device wedges, so the balancer routes away while in-flight work
+  finishes.  (``/healthz`` stays, byte-identical, as the deprecated
+  pre-split alias.)
+* SIGTERM/SIGINT → ``begin_drain()``: readiness flips, admission stops
+  (new requests shed with ``shed_reason: "draining"``), in-flight
+  streams run to their ``[DONE]`` and the device batcher's queue
+  empties — all bounded by ``DRAIN_TIMEOUT_MILLIS`` — then the cache
+  disk tier is flushed exactly once and the process exits 0.
+
+State machine: READY → DRAINING → STOPPED, one way.  ``begin_drain`` is
+idempotent (a supervisor re-sending SIGTERM joins the drain already in
+progress rather than restarting it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional
+
+READY = "ready"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+
+class Lifecycle:
+    def __init__(
+        self,
+        *,
+        admission=None,
+        batcher=None,
+        caches=(),
+        watchdog=None,
+        drain_timeout_ms: float = 10000.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.admission = admission
+        self.batcher = batcher
+        # cache stores with a flush() hook (cache/store.py); flushed
+        # exactly once, after the queues drain, so the disk tier holds
+        # everything the final dispatches produced
+        self.caches = [c for c in caches if c is not None]
+        self.watchdog = watchdog
+        self.drain_timeout_ms = float(drain_timeout_ms)
+        self.clock = clock
+        self.state = READY
+        self.drained_clean: Optional[bool] = None
+        self.drain_elapsed_ms: Optional[float] = None
+        self.cache_flushes = 0
+        self._drain_task: Optional[asyncio.Task] = None
+
+    # -- readiness ------------------------------------------------------------
+
+    def ready(self):
+        """(is_ready, reason) — the /readyz decision."""
+        if self.state != READY:
+            return False, self.state
+        if self.watchdog is not None and not self.watchdog.healthy():
+            return False, "device_unhealthy"
+        return True, None
+
+    # -- drain ----------------------------------------------------------------
+
+    def begin_drain(self) -> asyncio.Task:
+        """Start (or join) the drain; the returned task completes when
+        the drain does.  Idempotent — every SIGTERM after the first
+        awaits the same drain."""
+        if self._drain_task is None:
+            self._drain_task = asyncio.get_running_loop().create_task(
+                self._drain()
+            )
+        return self._drain_task
+
+    async def _drain(self) -> bool:
+        t0 = self.clock()
+        deadline = t0 + self.drain_timeout_ms / 1e3
+        # 1. stop admitting BEFORE waiting: readiness flips (the LB
+        #    routes away) and the admission gate sheds everything new
+        #    with a retryable 503, so the in-flight set only shrinks
+        self.state = DRAINING
+        if self.admission is not None:
+            self.admission.draining = True
+        # 2. in-flight requests run to completion (streams hold their
+        #    admission slot until the [DONE] frame is written)
+        clean = True
+        if self.admission is not None:
+            while self.admission.inflight > 0:
+                if self.clock() >= deadline:
+                    clean = False
+                    break
+                await asyncio.sleep(0.01)
+        # 3. the device batcher's queue empties (nothing refills it —
+        #    admission already stopped)
+        if self.batcher is not None:
+            remaining = max(0.0, deadline - self.clock())
+            clean = await self.batcher.drain(remaining) and clean
+        # 4. flush the cache disk tier exactly once: the last dispatched
+        #    results must be on disk before the process exits
+        for cache in self.caches:
+            cache.flush()
+            self.cache_flushes += 1
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.state = STOPPED
+        self.drained_clean = clean
+        self.drain_elapsed_ms = (self.clock() - t0) * 1e3
+        return clean
+
+    # -- observability --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        out = {
+            "state": self.state,
+            "drain_timeout_ms": self.drain_timeout_ms,
+            "cache_flushes": self.cache_flushes,
+        }
+        if self.drained_clean is not None:
+            out["drained_clean"] = self.drained_clean
+            out["drain_elapsed_ms"] = round(self.drain_elapsed_ms, 1)
+        return out
+
+
+def health_handlers(lifecycle: Optional[Lifecycle]):
+    """(livez, readyz) aiohttp handlers; a ``lifecycle`` of None (apps
+    built without the lifecycle wiring, e.g. unit-test gateways) is
+    always ready — the pre-split /healthz semantics."""
+    from aiohttp import web
+
+    async def livez(request):
+        return web.json_response({"ok": True})
+
+    async def readyz(request):
+        if lifecycle is None:
+            return web.json_response({"ready": True})
+        ok, reason = lifecycle.ready()
+        if ok:
+            return web.json_response({"ready": True})
+        return web.json_response(
+            {"ready": False, "reason": reason}, status=503
+        )
+
+    return livez, readyz

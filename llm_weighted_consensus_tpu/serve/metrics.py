@@ -76,6 +76,23 @@ def register_resilience(metrics: Metrics, policy, fault_plan=None) -> None:
     metrics.register_provider("resilience", _snapshot)
 
 
+def register_overload(
+    metrics: Metrics, admission=None, watchdog=None, lifecycle=None
+) -> None:
+    """Surface the overload/lifecycle subsystem on ``GET /metrics``:
+    the ``admission`` section (inflight gauge, adaptive limit, per-reason
+    shed counters), ``device_watchdog`` (health, active dispatches,
+    trip/recovery counters), and ``lifecycle`` (state, drain outcome,
+    cache flushes).  The batcher's own queue-depth gauge and shed
+    counters ride its existing ``device_batcher`` provider."""
+    if admission is not None:
+        metrics.register_provider("admission", admission.snapshot)
+    if watchdog is not None:
+        metrics.register_provider("device_watchdog", watchdog.snapshot)
+    if lifecycle is not None:
+        metrics.register_provider("lifecycle", lifecycle.snapshot)
+
+
 def _series(request) -> str:
     """Series key = the MATCHED route, so unmatched-path probes can't mint
     unbounded series (they all bucket under ``http:unmatched``)."""

@@ -44,3 +44,8 @@ def pytest_configure(config):
         "chaos: seeded fault-injection suite (scripts/chaos.sh); also "
         "marked slow so tier-1 (-m 'not slow') never pays for it",
     )
+    config.addinivalue_line(
+        "markers",
+        "soak: sustained-load / overload scenarios (bench_http.py --overload, "
+        "scripts/chaos.sh overload+SIGTERM); always also marked slow",
+    )

@@ -594,3 +594,116 @@ def test_embed_cache_duplicate_rows_in_one_request(embedder):
     # token accounting still counts BOTH rows (public contract unchanged)
     assert tokens == embedder.token_count(["dup row", "dup row"])
     assert metrics.snapshot()["device_batcher"]["items"] == 1
+
+
+# -- overload: bounded queue, deadline shed, departed callers (PR 4) ----------
+
+
+def test_queue_bound_fails_fast_with_overloaded(embedder):
+    from llm_weighted_consensus_tpu.errors import OverloadedError
+
+    metrics = Metrics()
+    batcher = DeviceBatcher(
+        embedder, metrics, window_ms=100.0, max_queue_depth=2
+    )
+
+    async def run():
+        t1 = asyncio.ensure_future(batcher.embed(["a"]))
+        t2 = asyncio.ensure_future(batcher.embed(["b"]))
+        await asyncio.sleep(0)  # both submitted; queue is at its bound
+        with pytest.raises(OverloadedError) as ei:
+            await batcher.embed(["c"])
+        assert ei.value.shed_reason == "batcher_queue_full"
+        assert ei.value.status() == 503
+        # queued work is unaffected by the shed
+        (e1, n1), (e2, n2) = await asyncio.gather(t1, t2)
+        assert e1.shape[0] == 1 and e2.shape[0] == 1
+
+    go(run())
+    util = batcher.utilization()
+    assert util["shed_queue_full"] == 1
+    assert util["max_queue_depth"] == 2
+    snap = metrics.snapshot()["series"]
+    assert snap["device:shed:queue_full"]["errors"] == 1
+
+
+def test_deadline_shed_before_dispatch_is_504(embedder):
+    from llm_weighted_consensus_tpu.errors import DeadlineExceededError
+    from llm_weighted_consensus_tpu.resilience import Deadline
+
+    metrics = Metrics()
+    batcher = DeviceBatcher(embedder, metrics, window_ms=20.0)
+
+    async def run():
+        token = Deadline(0.0005).activate()  # dead long before the window
+        try:
+            with pytest.raises(DeadlineExceededError) as ei:
+                await batcher.embed(["too late"])
+            assert ei.value.status() == 504
+        finally:
+            Deadline.deactivate(token)
+        # no deadline active -> same batcher keeps serving
+        emb, tokens = await batcher.embed(["in time"])
+        assert emb.shape[0] == 1 and tokens > 0
+
+    go(run())
+    assert batcher.shed_deadline == 1
+    snap = metrics.snapshot()["series"]
+    assert snap["device:shed:deadline"]["errors"] == 1
+
+
+def test_cancelled_caller_drops_item_before_dispatch(embedder):
+    """Regression (ISSUE PR 4 satellite): a departed caller — task
+    cancellation, or the GeneratorExit a client disconnect throws into a
+    streaming generator — must not leave its item to burn device time.
+    ``_submit`` cancels the future on the way out and ``_shed_group``
+    drops done futures before the group dispatches."""
+    metrics = Metrics()
+    batcher = DeviceBatcher(embedder, metrics, window_ms=40.0)
+
+    async def run():
+        t = asyncio.ensure_future(batcher.embed(["abandoned"]))
+        await asyncio.sleep(0.005)  # submitted, still inside the window
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert await batcher.drain(2.0)
+
+    go(run())
+    assert batcher.cancelled_items == 1
+    # the whole group was cancelled callers: nothing reached the device
+    assert batcher.utilization()["dispatches"] == 0
+    assert "device:batch:embed" not in metrics.snapshot()["series"]
+
+
+def test_drain_waits_for_queued_and_inflight_work(embedder):
+    batcher = DeviceBatcher(embedder, Metrics(), window_ms=10.0)
+
+    async def run():
+        assert batcher.idle()
+        t = asyncio.ensure_future(batcher.embed(["queued"]))
+        await asyncio.sleep(0)
+        assert not batcher.idle()
+        assert await batcher.drain(5.0) is True
+        assert batcher.idle()
+        emb, _ = await t
+        assert emb.shape[0] == 1
+
+    go(run())
+
+
+def test_watchdog_brackets_batcher_dispatches(embedder):
+    from llm_weighted_consensus_tpu.resilience import DeviceWatchdog
+
+    wd = DeviceWatchdog(60_000.0)  # generous: must never trip here
+    batcher = DeviceBatcher(embedder, Metrics(), window_ms=5.0, watchdog=wd)
+
+    async def run():
+        await asyncio.gather(
+            batcher.embed(["one"]), batcher.embed(["two"])
+        )
+
+    go(run())
+    assert wd.dispatches >= 1
+    assert wd.snapshot()["active_dispatches"] == 0  # every begin ended
+    assert wd.healthy() is True

@@ -1854,3 +1854,67 @@ def test_parse_phase_masks_non_valueerror_exceptions():
     body = json.loads(masked.text)
     assert body == {"code": 400, "message": "malformed request body"}
     assert secret not in masked.text
+
+
+def test_client_disconnect_mid_stream_cancels_pipeline():
+    """Regression (ISSUE PR 4 satellite): a client vanishing mid-SSE must
+    tear the pipeline down — _respond_streaming catches the broken-pipe
+    write, counts it, and its finally acloses the generator chain (whose
+    cleanup cancels judge pumps and pending batcher items)."""
+    from llm_weighted_consensus_tpu.serve.gateway import METRICS_KEY
+
+    keys = ballot_keys(2)
+    app, _ = make_app(
+        [
+            # frame 1 arrives half a second late: the client is long gone
+            # by the time the server tries to write the final frame
+            Script(
+                [
+                    chunk_obj("thinking"),
+                    chunk_obj(f"pick {keys[1]}", finish="stop"),
+                ],
+                delays={1: 0.5},
+            )
+        ]
+    )
+
+    async def run(client):
+        resp = await post_json(
+            client,
+            "/score/completions",
+            {
+                "stream": True,
+                "messages": [{"role": "user", "content": "q"}],
+                "model": inline_model([{"model": "j1"}]),
+                "choices": ["first", "second"],
+            },
+        )
+        assert resp.status == 200
+        await resp.content.readany()  # first frame made it through
+        resp.close()  # sever the connection mid-stream
+        metrics = app[METRICS_KEY]
+        for _ in range(300):
+            series = metrics.snapshot()["series"]
+            if "http:client_disconnect" in series:
+                break
+            await asyncio.sleep(0.01)
+        assert series["http:client_disconnect"]["count"] == 1
+        assert series["http:client_disconnect"]["errors"] == 1
+
+    go(with_client(app, run))
+
+
+def test_overloaded_error_response_carries_retry_after():
+    from llm_weighted_consensus_tpu.errors import OverloadedError
+    from llm_weighted_consensus_tpu.serve.gateway import _error_response
+
+    resp = _error_response(OverloadedError("batcher_queue_full"))
+    assert resp.status == 503
+    assert resp.headers["Retry-After"] == "1"
+    body = json.loads(resp.text)
+    assert body["message"]["shed_reason"] == "batcher_queue_full"
+
+    resp = _error_response(
+        OverloadedError("inflight_limit", retry_after_ms=3200.0)
+    )
+    assert resp.headers["Retry-After"] == "4"

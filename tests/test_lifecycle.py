@@ -1,0 +1,468 @@
+"""Overload & lifecycle: admission control (hard cap + AIMD), the device
+watchdog, and graceful drain ordering (ISSUE PR 4) — in-flight work
+completes, unadmitted work sheds 503, /readyz flips first, the cache disk
+tier flushes exactly once."""
+
+import asyncio
+import json
+import random
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_weighted_consensus_tpu import archive, registry
+from llm_weighted_consensus_tpu.ballot import PrefixTree
+from llm_weighted_consensus_tpu.cache.store import CacheStore
+from llm_weighted_consensus_tpu.clients.chat import (
+    ApiBase,
+    BackoffPolicy,
+    DefaultChatClient,
+)
+from llm_weighted_consensus_tpu.clients.score import ScoreClient
+from llm_weighted_consensus_tpu.identity.model import ModelBase
+from llm_weighted_consensus_tpu.resilience.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    shed_response,
+)
+from llm_weighted_consensus_tpu.resilience.watchdog import DeviceWatchdog
+from llm_weighted_consensus_tpu.serve import build_app
+from llm_weighted_consensus_tpu.serve.lifecycle import (
+    DRAINING,
+    READY,
+    STOPPED,
+    Lifecycle,
+)
+
+from fakes import FakeTransport, Script, chunk_obj
+
+SEED = 11
+NO_RETRY = BackoffPolicy(max_elapsed_ms=0)
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# -- admission: the pure controller -------------------------------------------
+
+
+def test_admission_zero_config_tracks_but_never_sheds():
+    ctrl = AdmissionController(AdmissionConfig())
+    for _ in range(100):
+        assert ctrl.try_acquire() is None
+    assert ctrl.inflight == 100
+    for _ in range(100):
+        ctrl.release(5.0)
+    assert ctrl.inflight == 0
+    assert ctrl.shed == {}
+
+
+def test_admission_hard_cap_sheds_and_recovers():
+    ctrl = AdmissionController(AdmissionConfig(max_inflight=2))
+    assert ctrl.try_acquire() is None
+    assert ctrl.try_acquire() is None
+    assert ctrl.try_acquire() == "inflight_limit"
+    assert ctrl.shed == {"inflight_limit": 1}
+    ctrl.release(5.0)
+    assert ctrl.try_acquire() is None  # slot freed -> admits again
+
+
+def test_admission_draining_sheds_everything():
+    ctrl = AdmissionController(AdmissionConfig(max_inflight=10))
+    ctrl.draining = True
+    assert ctrl.try_acquire() == "draining"
+    assert ctrl.try_acquire(device_work=True) == "draining"
+    assert ctrl.inflight == 0
+
+
+def test_admission_device_gate_sheds_only_device_work():
+    ctrl = AdmissionController(
+        AdmissionConfig(max_inflight=10),
+        device_gate=lambda: "device_unhealthy",
+    )
+    assert ctrl.try_acquire() is None  # host-only work keeps flowing
+    assert ctrl.try_acquire(device_work=True) == "device_unhealthy"
+    assert ctrl.shed == {"device_unhealthy": 1}
+
+
+def test_admission_adaptive_decrease_cooldown_and_additive_increase():
+    now = [0.0]
+    ctrl = AdmissionController(
+        AdmissionConfig(
+            max_inflight=10, adaptive=True, min_limit=2, latency_factor=2.0
+        ),
+        clock=lambda: now[0],
+    )
+    # establish the baseline (~10ms)
+    ctrl.try_acquire()
+    ctrl.release(10.0)
+    assert ctrl.limit == 10.0
+    # congestion: multiplicative decrease...
+    ctrl.try_acquire()
+    ctrl.release(100.0)
+    assert ctrl.limit == pytest.approx(9.0)
+    # ...but not twice inside the cooldown window
+    ctrl.try_acquire()
+    ctrl.release(100.0)
+    assert ctrl.limit == pytest.approx(9.0)
+    now[0] += 1.0
+    ctrl.try_acquire()
+    ctrl.release(100.0)
+    assert ctrl.limit == pytest.approx(8.1)
+    # the shrunken limit gates admission below the hard cap
+    while ctrl.try_acquire() is None:
+        pass
+    assert ctrl.inflight == 8  # int(8.1), not max_inflight
+    assert "inflight_limit" in ctrl.shed
+    # full-but-healthy: additive increase (+1/limit)
+    before = ctrl.limit
+    ctrl.release(12.0)  # under latency_factor x baseline
+    assert ctrl.limit == pytest.approx(before + 1.0 / before)
+    snap = ctrl.snapshot()
+    assert snap["limit"] == round(ctrl.limit, 2)
+    assert snap["baseline_ms"] > 0
+
+
+def test_shed_response_shape():
+    resp = shed_response("inflight_limit", 1500.0)
+    assert resp.status == 503
+    assert resp.headers["Retry-After"] == "2"  # ceil(1500ms)
+    body = json.loads(resp.text)
+    assert body == {
+        "code": 503,
+        "message": {"kind": "overloaded", "shed_reason": "inflight_limit"},
+    }
+
+
+# -- device watchdog ----------------------------------------------------------
+
+
+def test_watchdog_trip_and_recover():
+    now = [0.0]
+    events = []
+    wd = DeviceWatchdog(
+        100.0,
+        clock=lambda: now[0],
+        on_trip=lambda label, ms: events.append(("trip", label, ms)),
+        on_recover=lambda: events.append(("recover",)),
+    )
+    token = wd.begin("embed")
+    now[0] = 0.05
+    assert wd.check() is True  # under timeout_ms: healthy
+    now[0] = 0.2
+    assert wd.check() is False  # 200ms > 100ms: tripped
+    assert wd.trips == 1
+    assert wd.check() is False  # still down; no double trip
+    assert wd.trips == 1
+    snap = wd.snapshot()
+    assert snap["healthy"] is False
+    assert snap["overdue_kind"] == "embed"
+    assert snap["overdue_ms"] == pytest.approx(200.0)
+    wd.end(token)  # the wedged dispatch came back
+    assert wd.healthy() is True
+    assert wd.recoveries == 1
+    assert events == [("trip", "embed", pytest.approx(200.0)), ("recover",)]
+
+
+def test_watchdog_recovery_waits_for_all_overdue():
+    now = [0.0]
+    wd = DeviceWatchdog(100.0, clock=lambda: now[0])
+    t1 = wd.begin("embed")
+    t2 = wd.begin("consensus")
+    now[0] = 0.3
+    assert wd.check() is False
+    wd.end(t1)
+    assert wd.healthy() is False  # t2 still overdue
+    wd.end(t2)
+    assert wd.healthy() is True
+
+
+def test_watchdog_thread_start_stop():
+    wd = DeviceWatchdog(50.0, interval_ms=5.0)
+    wd.start()
+    wd.start()  # idempotent
+    token = wd.begin("embed")
+    wd.end(token)
+    wd.stop()
+    assert wd.healthy() is True
+    assert wd.dispatches == 1
+
+
+# -- lifecycle: drain state machine -------------------------------------------
+
+
+class _FakeBatcher:
+    def __init__(self, clean=True):
+        self.clean = clean
+        self.drains = 0
+
+    async def drain(self, timeout_sec):
+        self.drains += 1
+        return self.clean
+
+
+def test_drain_flushes_caches_exactly_once():
+    admission = AdmissionController(AdmissionConfig())
+    batcher = _FakeBatcher()
+    c1 = CacheStore(60.0, 1 << 20)
+    c2 = CacheStore(60.0, 1 << 20)
+    lc = Lifecycle(
+        admission=admission,
+        batcher=batcher,
+        caches=(c1, c2, None),  # None members are tolerated
+        drain_timeout_ms=1000.0,
+    )
+
+    async def run():
+        assert lc.ready() == (True, None)
+        t1 = lc.begin_drain()
+        t2 = lc.begin_drain()
+        assert t1 is t2  # idempotent: one drain, every SIGTERM joins it
+        return await t1
+
+    assert go(run()) is True
+    assert lc.state == STOPPED
+    assert admission.draining is True
+    assert batcher.drains == 1
+    assert c1.flushes == 1 and c2.flushes == 1
+    assert lc.cache_flushes == 2
+    assert lc.drained_clean is True
+    assert lc.ready() == (False, STOPPED)
+    snap = lc.snapshot()
+    assert snap["state"] == STOPPED
+    assert snap["drained_clean"] is True
+
+
+def test_drain_timeout_reports_unclean():
+    admission = AdmissionController(AdmissionConfig())
+    admission.inflight = 1  # a request that never finishes
+    cache = CacheStore(60.0, 1 << 20)
+    lc = Lifecycle(
+        admission=admission, caches=(cache,), drain_timeout_ms=30.0
+    )
+    assert go(lc._drain()) is False
+    assert lc.drained_clean is False
+    assert lc.drain_elapsed_ms >= 30.0
+    assert cache.flushes == 1  # flushed even on an unclean drain
+
+
+def test_ready_reflects_watchdog_health():
+    now = [0.0]
+    wd = DeviceWatchdog(100.0, clock=lambda: now[0])
+    lc = Lifecycle(watchdog=wd)
+    assert lc.ready() == (True, None)
+    wd.begin("embed")
+    now[0] = 1.0
+    wd.check()
+    assert lc.ready() == (False, "device_unhealthy")
+
+
+def test_lifecycle_states_exported():
+    assert (READY, DRAINING, STOPPED) == ("ready", "draining", "stopped")
+
+
+# -- gateway integration: drain ordering over HTTP ----------------------------
+
+
+def ballot_keys(n):
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, n, 20)
+    return {idx: k for k, idx in tree.key_indices(rng)}
+
+
+def inline_model(judges):
+    model = ModelBase.from_json_obj({"llms": judges}).into_model_validate()
+    return {"llms": [llm.base.to_json_obj() for llm in model.llms]}
+
+
+def post_json(client, path, obj):
+    from llm_weighted_consensus_tpu.utils import jsonutil
+
+    return client.post(
+        path,
+        data=jsonutil.dumps(obj),
+        headers={"content-type": "application/json"},
+    )
+
+
+def sse_events(text):
+    return [
+        block[len("data: "):]
+        for block in text.split("\n\n")
+        if block.startswith("data: ")
+    ]
+
+
+def make_overload_app(scripts, admission, caches=()):
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    score = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+    )
+    lifecycle = Lifecycle(
+        admission=admission, caches=caches, drain_timeout_ms=5000.0
+    )
+    app = build_app(
+        chat, score, admission=admission, lifecycle=lifecycle
+    )
+    return app, lifecycle
+
+
+def score_body(keys):
+    return {
+        "stream": True,
+        "messages": [{"role": "user", "content": "q"}],
+        "model": inline_model([{"model": "j1"}]),
+        "choices": ["first", "second"],
+    }
+
+
+def test_drain_ordering_inflight_completes_unadmitted_sheds():
+    """The drain contract end to end: /readyz flips the moment the drain
+    begins (while the in-flight stream is still running), new work sheds
+    503 shed_reason=draining, the in-flight stream runs to [DONE], and
+    the cache disk tier flushes exactly once."""
+    keys = ballot_keys(2)
+    cache = CacheStore(60.0, 1 << 20)
+    admission = AdmissionController(AdmissionConfig(max_inflight=8))
+    app, lifecycle = make_overload_app(
+        # the judge's only frame is delayed: the stream stays in flight
+        # long enough for the drain to begin around it
+        [Script([chunk_obj(f"pick {keys[1]}", finish="stop")],
+                delays={0: 0.25})],
+        admission,
+        caches=(cache,),
+    )
+
+    async def run(client):
+        inflight = asyncio.ensure_future(
+            post_json(client, "/score/completions", score_body(keys))
+        )
+        await asyncio.sleep(0.05)  # judge frame still 200ms away
+        assert admission.inflight == 1
+        ready = await client.get("/readyz")
+        assert ready.status == 200
+
+        drain = lifecycle.begin_drain()
+        # 1. readiness flips immediately (probe paths stay exempt)
+        ready = await client.get("/readyz")
+        assert ready.status == 503
+        assert (await ready.json()) == {"ready": False, "reason": "draining"}
+        livez = await client.get("/livez")
+        assert (await livez.json()) == {"ok": True}  # liveness unaffected
+        # 2. queued-but-unadmitted work sheds with a retryable 503
+        shed = await post_json(
+            client, "/score/completions", score_body(keys)
+        )
+        assert shed.status == 503
+        assert "Retry-After" in shed.headers
+        body = await shed.json()
+        assert body["message"]["shed_reason"] == "draining"
+        # 3. the in-flight stream completes normally, [DONE] and all
+        resp = await inflight
+        assert resp.status == 200
+        events = sse_events(await resp.text())
+        assert events[-1] == "[DONE]"
+        final = json.loads(events[-2])
+        assert any(
+            c.get("confidence") == 1
+            for c in final["choices"]
+            if c["index"] < 2
+        )
+        # 4. the drain finishes clean; the disk tier flushed exactly once
+        assert await drain is True
+        assert lifecycle.state == STOPPED
+        assert cache.flushes == 1
+        assert admission.inflight == 0
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await run(client)
+        finally:
+            await client.close()
+
+    go(main())
+
+
+def test_inflight_limit_sheds_second_request():
+    keys = ballot_keys(2)
+    admission = AdmissionController(AdmissionConfig(max_inflight=1))
+    app, _ = make_overload_app(
+        [
+            Script([chunk_obj(f"pick {keys[1]}", finish="stop")],
+                   delays={0: 0.25}),
+            Script([chunk_obj(f"pick {keys[1]}", finish="stop")]),
+        ],
+        admission,
+    )
+
+    async def run(client):
+        first = asyncio.ensure_future(
+            post_json(client, "/score/completions", score_body(keys))
+        )
+        await asyncio.sleep(0.05)
+        shed = await post_json(
+            client, "/score/completions", score_body(keys)
+        )
+        assert shed.status == 503
+        body = await shed.json()
+        assert body["message"]["shed_reason"] == "inflight_limit"
+        assert shed.headers["Retry-After"] == "1"
+        resp = await first
+        await resp.text()  # run the stream out: the slot frees
+        after = await post_json(
+            client, "/score/completions", score_body(keys)
+        )
+        assert after.status == 200
+        await after.text()
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await run(client)
+        finally:
+            await client.close()
+
+    go(main())
+
+
+def test_readyz_without_lifecycle_always_ready():
+    admission = AdmissionController(AdmissionConfig())
+    transport = FakeTransport([])
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    score = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+    )
+    app = build_app(chat, score, admission=admission)
+
+    async def run(client):
+        assert (await (await client.get("/livez")).json()) == {"ok": True}
+        assert (await (await client.get("/readyz")).json()) == {
+            "ready": True
+        }
+        # the deprecated alias stays byte-identical
+        assert (await (await client.get("/healthz")).json()) == {"ok": True}
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await run(client)
+        finally:
+            await client.close()
+
+    go(main())

@@ -1,0 +1,151 @@
+"""Overload + SIGTERM drill (scripts/chaos.sh): the REAL server process
+under open-loop overload with PR 2's FAULT_PLAN stalls, SIGTERM'd
+mid-load.  The contract (ISSUE PR 4 acceptance): exit 0 within
+DRAIN_TIMEOUT_MILLIS, zero truncated SSE streams among admitted
+requests, the excess shed with retryable 503s.
+
+Marked chaos+slow+soak: never in tier-1; scripts/chaos.sh runs it."""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow, pytest.mark.soak]
+
+DRAIN_TIMEOUT_MS = 10_000.0
+
+
+def _score_body(i: int) -> str:
+    return json.dumps(
+        {
+            "stream": True,
+            "messages": [{"role": "user", "content": f"question {i}"}],
+            "model": {"llms": [{"model": "fake-judge"}]},
+            "choices": [f"candidate a {i}", f"candidate b {i}"],
+        }
+    )
+
+
+def test_sigterm_under_overload_drains_clean(tmp_path):
+    from aiohttp import ClientError, ClientSession
+    from aiohttp.test_utils import unused_port
+
+    port = unused_port()
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            # host-only service (no EMBEDDER_MODEL): the drill targets
+            # admission/drain, not the device path
+            "EMBEDDER_MODEL": "",
+            "ADMISSION_MAX_INFLIGHT": "4",
+            "ADMISSION_MAX_QUEUE_DEPTH": "8",
+            "DRAIN_TIMEOUT_MILLIS": str(int(DRAIN_TIMEOUT_MS)),
+            # each admitted stream holds its slot ~300ms: SIGTERM lands
+            # while several are genuinely mid-flight
+            "FAKE_UPSTREAM_DELAY_MS": "300",
+            # PR 2's seeded fault plan: mid-stream stalls ride along, so
+            # the drain proves itself against misbehaving upstreams too
+            "FAULT_PLAN": "seed=42,stall_mid=0.2,stall_ms=200",
+        }
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "llm_weighted_consensus_tpu.serve",
+            "--fake-upstream",
+            "--port",
+            str(port),
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    base = f"http://127.0.0.1:{port}"
+    results: list = []  # (status, text) of every answered request
+    refused = 0
+
+    async def drive():
+        nonlocal refused
+        async with ClientSession(
+            headers={"content-type": "application/json"}
+        ) as session:
+            # wait for readiness (cold start: imports + route setup)
+            deadline = time.monotonic() + 120.0
+            while True:
+                try:
+                    async with session.get(base + "/readyz") as resp:
+                        if resp.status == 200:
+                            break
+                except ClientError:
+                    pass
+                assert time.monotonic() < deadline, "server never ready"
+                await asyncio.sleep(0.2)
+
+            async def one(i):
+                nonlocal refused
+                try:
+                    async with session.post(
+                        base + "/score/completions", data=_score_body(i)
+                    ) as resp:
+                        results.append((resp.status, await resp.text()))
+                except ClientError:
+                    refused += 1  # listener already closed: acceptable
+                    # only for requests fired after the drain finished
+
+            # open loop at ~50/s against ~13/s capacity (4 slots x
+            # ~300ms); SIGTERM lands mid-burst
+            tasks = []
+            sigterm_at = None
+            for i in range(24):
+                tasks.append(asyncio.ensure_future(one(i)))
+                if i == 11:
+                    proc.send_signal(signal.SIGTERM)
+                    sigterm_at = time.monotonic()
+                await asyncio.sleep(0.02)
+            await asyncio.gather(*tasks)
+            return sigterm_at
+
+    sigterm_at = asyncio.new_event_loop().run_until_complete(drive())
+
+    # exit 0, within the drain budget (+ generous teardown slack)
+    rc = proc.wait(timeout=DRAIN_TIMEOUT_MS / 1e3 + 30.0)
+    exited_after_ms = (time.monotonic() - sigterm_at) * 1e3
+    out = proc.stdout.read()
+    assert rc == 0, f"server exited {rc}:\n{out[-2000:]}"
+    assert exited_after_ms < DRAIN_TIMEOUT_MS + 15_000.0
+    assert "draining (SIGTERM/SIGINT received)..." in out
+
+    statuses = [s for s, _ in results]
+    admitted = [(s, t) for s, t in results if s == 200]
+    shed = [(s, t) for s, t in results if s in (503, 504)]
+    assert admitted, f"no admitted requests at all: {statuses}"
+    assert shed, f"nothing shed under 4x overload + drain: {statuses}"
+    # THE acceptance line: zero truncated SSE streams among admitted —
+    # every 200 ran to its [DONE] through the SIGTERM
+    for _, text in admitted:
+        assert text.rstrip().endswith("data: [DONE]"), (
+            "truncated SSE stream across drain:\n" + text[-500:]
+        )
+    # sheds are well-formed retryable 503 envelopes
+    for status, text in shed:
+        if status == 503:
+            body = json.loads(text)
+            assert body["message"]["shed_reason"] in (
+                "draining",
+                "inflight_limit",
+                "batcher_queue_full",
+            )
+    # every request accounted for: answered 200/503/504, or refused
+    # because it raced the post-drain listener close
+    assert len(results) + refused == 24
+    assert all(s in (200, 503, 504) for s in statuses), statuses

@@ -392,7 +392,13 @@ def main() -> int:
         type=float,
         default=240.0,
         help="hard bound (s) on the throwaway backend-init probe; on "
-        "expiry one degraded JSON record is emitted instead of hanging",
+        "expiry one degraded JSON record is emitted instead of hanging. "
+        "Historically this bounded ONLY the probe: a PJRT call that "
+        "wedged AFTER a clean probe (first real dispatch, mid-bench) "
+        "could still hang the round forever.  The bench body now runs "
+        "under its own watchdog (probe-timeout + 600 s, covering worst-"
+        "case cold compiles) that emits the degraded record and exits 2 "
+        "on expiry, closing that residual window",
     )
     parser.add_argument(
         "--quantize",
@@ -417,6 +423,36 @@ def main() -> int:
     if not probe["ok"]:
         emit_degraded(args, probe, "tpu-unavailable")
         return 2
+
+    # The probe bounds backend INIT only.  A PJRT call that wedges after a
+    # clean probe (ADVICE r5: first real dispatch or mid-bench) used to
+    # hang the round with no record.  A wedged device call is not
+    # interruptible from Python (SIGALRM handlers never run while the
+    # runtime holds the GIL inside PJRT), so the watchdog is a daemon
+    # timer that emits the degraded record itself and hard-exits: os._exit
+    # skips atexit/GC that could block on the same wedged runtime.
+    import os
+    import threading
+
+    budget = args.probe_timeout + 600.0
+
+    def _expired() -> None:
+        emit_degraded(
+            args,
+            {
+                "backend": probe["backend"],
+                "error": f"bench body exceeded {budget:.0f}s watchdog "
+                "(device call wedged after a clean probe)",
+            },
+            "bench-hung",
+        )
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(2)
+
+    watchdog = threading.Timer(budget, _expired)
+    watchdog.daemon = True
+    watchdog.start()
     try:
         return run_bench(args, probe["backend"])
     except Exception as exc:
@@ -428,6 +464,8 @@ def main() -> int:
         emit_degraded(args, {"backend": probe["backend"], "error": repr(exc)},
                       "bench-failed")
         return 1
+    finally:
+        watchdog.cancel()
 
 
 def run_bench(args, backend: str) -> int:

@@ -80,15 +80,22 @@ class SingleFlight:
         caller's cancellation cannot poison the shared flight.  Returns
         ``(ok, value)``: ``ok`` False means the leader was cancelled and
         the caller should retry ``claim`` (likely becoming the leader)."""
-        try:
-            return True, await asyncio.shield(future)
-        except asyncio.CancelledError:
-            if future.cancelled() or (
-                future.done()
-                and isinstance(future.exception(), asyncio.CancelledError)
-            ):
-                return False, None  # leader abandoned; caller retries
-            raise  # caller itself was cancelled
+        from ..obs import span
+
+        with span("singleflight:wait") as s:
+            try:
+                return True, await asyncio.shield(future)
+            except asyncio.CancelledError:
+                if future.cancelled() or (
+                    future.done()
+                    and isinstance(
+                        future.exception(), asyncio.CancelledError
+                    )
+                ):
+                    if s is not None:
+                        s.annotate(leader_abandoned=True)
+                    return False, None  # leader abandoned; caller retries
+                raise  # caller itself was cancelled
 
     # -- classic interface ---------------------------------------------------
 

@@ -294,6 +294,12 @@ class ScoreCache(CacheStore):
         )
 
     def put_chunks(self, fp: str, chunk_objs: list) -> None:
+        # the recording leader's trace_id must not leak into replays: a
+        # cache hit is a different request with (usually) no trace, and a
+        # stale id pointing at the leader's span tree would mislead more
+        # than it helps — cached responses simply carry no trace_id
+        for obj in chunk_objs:
+            obj.pop("trace_id", None)
         self.put(fp, chunk_objs, self.measure(chunk_objs))
 
     def decode_value(self, obj):

@@ -34,6 +34,7 @@ from dataclasses import dataclass
 from typing import AsyncIterator, Optional
 
 from .. import archive as archive_mod
+from .. import obs
 from ..errors import (
     BadStatusError,
     BreakerOpenError,
@@ -330,6 +331,7 @@ class DefaultChatClient(ChatClient):
             if deadline is not None:
                 if deadline.expired():
                     self._inc("deadline_expired")
+                    obs.annotate(deadline_expired="retry loop")
                     raise DeadlineExceededError("retry loop")
                 # never sleep past the deadline: wake with whatever budget
                 # is left and let the next attempt's clamped timeouts decide
@@ -339,6 +341,7 @@ class DefaultChatClient(ChatClient):
                 # the fan-out's shared retry budget is dry: fail this judge
                 # over to its error path instead of joining a retry storm
                 self._inc("retry_denied")
+                obs.annotate(retry_denied=True)
                 raise last_error if last_error is not None else EmptyStreamError()
             await asyncio.sleep(sleep)
 
@@ -379,9 +382,15 @@ class DefaultChatClient(ChatClient):
             budget = current_retry_budget()
             if budget is not None and not budget.try_acquire():
                 self._inc("hedge_denied")
+                obs.annotate(hedge_denied=True)
                 return await primary
 
             self._inc("hedge_launched")
+            obs.annotate(
+                hedge_launched=True,
+                hedge_delay_ms=delay_ms,
+                hedge=policy.hedge.explain(),
+            )
             backup = asyncio.create_task(
                 self._open_committed(attempts[(i + 1) % len(attempts)], request)
             )
@@ -405,6 +414,7 @@ class DefaultChatClient(ChatClient):
                 if winner is not None:
                     if winner[0] is backup:
                         self._inc("hedge_won")
+                        obs.annotate(hedge_won=True)
                     await _discard_attempts(tasks)
                     return winner[1]
             return last
@@ -420,53 +430,89 @@ class DefaultChatClient(ChatClient):
 
         Returns ``(stream, api_base)`` on commit or the ``ChatError`` that
         felled it; the outcome lands on the attempt's breaker and a commit's
-        first-chunk latency feeds the hedge tracker."""
+        first-chunk latency feeds the hedge tracker.
+
+        The attempt span (child of the ambient judge span — hedged
+        attempts run as tasks that inherit it, so primary and backup
+        become sibling children) covers gate -> open -> first-chunk
+        commit; its activation makes the outgoing ``traceparent`` name
+        THIS attempt as the upstream's parent."""
         policy = self.resilience
-        breaker = None
-        if policy is not None and policy.breakers is not None:
-            breaker = policy.breakers.get(attempt.api_base.api_base, attempt.model)
-            if not breaker.allow():
-                self._inc("breaker_rejected")
-                return BreakerOpenError(attempt.api_base.api_base, attempt.model)
-        # allow() may have claimed a half-open probe slot; from here on
-        # every exit must settle it — record an outcome, or release it when
-        # the attempt is cancelled / ends without a verdict
-        resolved = breaker is None
+        aspan = obs.child_span(
+            "judge:attempt",
+            api_base=attempt.api_base.api_base,
+            model=attempt.model,
+        )
+        atoken = aspan.activate() if aspan is not None else None
         try:
-            # per-attempt clone: hedged attempts run concurrently and must not
-            # race on the shared request's model field
-            req = request.clone()
-            req.model = attempt.model
-            start = time.monotonic()
-            stream = self._open_event_stream(attempt.api_base, req)
-            # first-chunk peek: commit only on a good first chunk
+            breaker = None
+            if policy is not None and policy.breakers is not None:
+                breaker = policy.breakers.get(
+                    attempt.api_base.api_base, attempt.model
+                )
+                if aspan is not None:
+                    aspan.annotate(breaker_state=breaker.describe())
+                if not breaker.allow():
+                    self._inc("breaker_rejected")
+                    if aspan is not None:
+                        aspan.annotate(breaker_rejected=True)
+                        aspan.finish("error")
+                    return BreakerOpenError(
+                        attempt.api_base.api_base, attempt.model
+                    )
+            # allow() may have claimed a half-open probe slot; from here on
+            # every exit must settle it — record an outcome, or release it
+            # when the attempt is cancelled / ends without a verdict
+            resolved = breaker is None
             try:
-                first = await stream.__anext__()
-            except StopAsyncIteration:
-                first = EmptyStreamError()
-            if isinstance(first, ChatError):
+                # per-attempt clone: hedged attempts run concurrently and
+                # must not race on the shared request's model field
+                req = request.clone()
+                req.model = attempt.model
+                start = time.monotonic()
+                stream = self._open_event_stream(attempt.api_base, req)
+                # first-chunk peek: commit only on a good first chunk
+                try:
+                    first = await stream.__anext__()
+                except StopAsyncIteration:
+                    first = EmptyStreamError()
+                if isinstance(first, ChatError):
+                    if breaker is not None:
+                        if _breaker_failure(first):
+                            breaker.record_failure()
+                        elif isinstance(first, DeadlineExceededError):
+                            # our budget ran out before the upstream
+                            # answered: neither success nor failure — the
+                            # upstream's health was never actually probed
+                            breaker.release_probe()
+                        else:
+                            breaker.record_success()
+                        resolved = True
+                    await stream.aclose()
+                    if aspan is not None:
+                        # attempt-level failures are routine (the retry
+                        # loop may still commit): mark the span errored
+                        # without forcing trace retention — that verdict
+                        # belongs to the judge/request outcome
+                        aspan.annotate(error=str(first))
+                        aspan.finish("error")
+                    return first
                 if breaker is not None:
-                    if _breaker_failure(first):
-                        breaker.record_failure()
-                    elif isinstance(first, DeadlineExceededError):
-                        # our budget ran out before the upstream answered:
-                        # neither success nor failure — the upstream's
-                        # health was never actually probed
-                        breaker.release_probe()
-                    else:
-                        breaker.record_success()
+                    breaker.record_success()
                     resolved = True
-                await stream.aclose()
-                return first
-            if breaker is not None:
-                breaker.record_success()
-                resolved = True
-            if policy is not None and policy.hedge is not None:
-                policy.hedge.observe((time.monotonic() - start) * 1000.0)
-            return _prepend(first, stream), attempt.api_base
+                first_chunk_ms = (time.monotonic() - start) * 1000.0
+                if policy is not None and policy.hedge is not None:
+                    policy.hedge.observe(first_chunk_ms)
+                if aspan is not None:
+                    aspan.annotate(first_chunk_ms=round(first_chunk_ms, 3))
+                return _prepend(first, stream), attempt.api_base
+            finally:
+                if not resolved:
+                    breaker.release_probe()
         finally:
-            if not resolved:
-                breaker.release_probe()
+            if aspan is not None:
+                obs.Span.deactivate(atoken)
+                aspan.finish()
 
     # -- stream machinery ---------------------------------------------------
 
@@ -482,6 +528,9 @@ class DefaultChatClient(ChatClient):
         if self.referer:
             headers["referer"] = self.referer
             headers["http-referer"] = self.referer
+        # W3C traceparent: the ambient span (the attempt span, when one
+        # is active) becomes the upstream's parent — no-op untraced
+        obs.inject(headers)
         return headers
 
     async def _open_event_stream(self, api_base: ApiBase, request):
@@ -564,6 +613,9 @@ class DefaultChatClient(ChatClient):
                 event = pending.pop(0)
                 first = False
                 if event == DONE_FRAME:
+                    # annotates the ambient judge span (this generator
+                    # body runs in the judge's pump task)
+                    obs.annotate(sse_events=parser.events_parsed)
                     return
                 if not event or event.startswith(":"):
                     continue

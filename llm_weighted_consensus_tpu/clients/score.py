@@ -38,6 +38,7 @@ import time
 from typing import AsyncIterator, Optional
 
 from .. import archive as archive_mod
+from .. import obs
 from ..ballot import (
     PrefixTree,
     ballot_instruction,
@@ -433,24 +434,42 @@ class ScoreClient:
         leader streams live while recording, concurrent identical
         requests await the leader's recording and replay it."""
         fp = self._cache_key(ctx, params)
+        # front-door span: one per request, closed at the routing decision
+        # (hit / leader / follower / bypass) — the streaming itself is
+        # covered by the judge/tally spans downstream
+        cspan = obs.child_span("cache:lookup")
+
+        def _decide(outcome: str) -> None:
+            if cspan is not None:
+                cspan.annotate(outcome=outcome)
+                cspan.finish()
+
         if fp is None:
+            _decide("bypass")
             return await self._create_streaming_live(ctx, params)
         from ..cache import replay_stream
 
+        waits = 0
         while True:
             record = self.cache.get(fp)
             if record is not None:
+                _decide("hit" if waits == 0 else "follower")
                 return replay_stream(record)
             future = self.flights.claim(fp)
             if future is None:  # leader
+                _decide("leader")
                 try:
                     live = await self._create_streaming_live(ctx, params)
                 except BaseException as e:
                     self.flights.fail(fp, e)
                     raise
                 return self._record_and_stream(fp, live)
+            waits += 1
+            if cspan is not None:
+                cspan.annotate(singleflight_waits=waits)
             ok, record = await self.flights.wait(future)
             if ok:
+                _decide("follower")
                 return replay_stream(record)
             # leader abandoned (disconnect) or produced an uncacheable
             # stream: retry — this caller likely becomes the new leader
@@ -646,6 +665,7 @@ class ScoreClient:
                         # streams, which close their upstreams) and ship
                         degraded = True
                         policy.inc("quorum_degraded")
+                        obs.annotate(quorum=quorum.explain())
                         break
         finally:
             await merged.aclose()
@@ -680,9 +700,15 @@ class ScoreClient:
                 ):
                     degraded = True
                     policy.inc("deadline_degraded")
+                    obs.annotate(deadline_degraded=True)
 
         # tally + all-error detection (client.rs:384-416)
         from decimal import Decimal
+
+        # the tally span's attributes are the consensus "explain" record:
+        # per-judge vote/weight/contribution plus per-candidate results —
+        # built only when a trace is live (None otherwise, zero cost)
+        tspan = obs.child_span("consensus:tally", n_judges=len(model.llms))
 
         choice_weight = [Decimal(0)] * n_choices
         all_error = True
@@ -713,6 +739,8 @@ class ScoreClient:
         aggregate.usage = usage
         if degraded:
             aggregate.degraded = True
+        explain_candidates: list = []
+        explain_judges: list = []
         for choice in aggregate.choices:
             if choice.index < n_choices:
                 w = choice_weight[choice.index]
@@ -720,6 +748,14 @@ class ScoreClient:
                 choice.confidence = (
                     w / weight_sum if weight_sum > 0 else Decimal(0)
                 )
+                if tspan is not None:
+                    explain_candidates.append(
+                        {
+                            "index": choice.index,
+                            "weight": float(w),
+                            "confidence": float(choice.confidence),
+                        }
+                    )
             elif choice.delta.vote is not None:
                 vote = choice.delta.vote
                 confidence = Decimal(0)
@@ -731,6 +767,37 @@ class ScoreClient:
                     )
                     confidence += share * v
                 choice.confidence = confidence
+                if tspan is not None:
+                    explain_judges.append(
+                        {
+                            "model": choice.model,
+                            "model_index": choice.model_index,
+                            "weight": float(choice.weight)
+                            if choice.weight is not None
+                            else None,
+                            "vote": [float(v) for v in vote],
+                            "confidence_contribution": float(confidence),
+                            "error": choice.error.code
+                            if choice.error is not None
+                            else None,
+                        }
+                    )
+            elif tspan is not None:
+                # voteless judge choice: errored or cancelled
+                explain_judges.append(
+                    {
+                        "model": choice.model,
+                        "model_index": choice.model_index,
+                        "weight": float(choice.weight)
+                        if choice.weight is not None
+                        else None,
+                        "vote": None,
+                        "confidence_contribution": 0.0,
+                        "error": choice.error.code
+                        if choice.error is not None
+                        else None,
+                    }
+                )
             choice.delta = Delta()
             choice.finish_reason = None
             choice.logprobs = None
@@ -738,6 +805,27 @@ class ScoreClient:
                 choice.error = None
             # degraded: keep per-judge failure detail on the final frame so
             # unary consumers see WHY the panel is partial
+        if tspan is not None:
+            winner = None
+            if weight_sum > 0:
+                winner = max(
+                    range(n_choices), key=lambda i: choice_weight[i]
+                )
+            tspan.annotate(
+                judges=explain_judges,
+                candidates=explain_candidates,
+                weight_sum=float(weight_sum),
+                winner=winner,
+                degraded=degraded,
+            )
+            tspan.finish()
+        if degraded:
+            # degraded consensus is always retained, whatever the sample
+            # rate said at the door
+            obs.force_keep("degraded")
+        # the final frame carries the trace id so SSE consumers can fetch
+        # the explain trace from /v1/traces/{trace_id}
+        aggregate.trace_id = obs.current_trace_id()
         yield aggregate
 
         if all_error and len(model.llms) > 0:
@@ -787,6 +875,33 @@ class ScoreClient:
     async def _judge_stream(
         self, ctx, resp_id, created, indexer, llm, weight, request
     ):
+        """Span wrapper around the ballot stream proper.  This generator is
+        driven by exactly one dedicated pump task (merge_streams), so the
+        judge span can live in the pump's contextvar context: the chat
+        client's attempt spans and retry/hedge annotations land under it,
+        isolated from sibling judges."""
+        inner = self._judge_stream_inner(
+            ctx, resp_id, created, indexer, llm, weight, request
+        )
+        jspan = obs.child_span(
+            "judge:stream",
+            model=llm.id,
+            judge_index=llm.index,
+            weight=float(weight),
+        )
+        token = jspan.activate() if jspan is not None else None
+        try:
+            async for item in inner:
+                yield item
+        finally:
+            await inner.aclose()
+            if jspan is not None:
+                obs.Span.deactivate(token)
+                jspan.finish()
+
+    async def _judge_stream_inner(
+        self, ctx, resp_id, created, indexer, llm, weight, request
+    ):
         rng = self.rng_factory()
         n_choices = len(request.choices)
 
@@ -806,6 +921,8 @@ class ScoreClient:
         )
 
         def error_chunk(err) -> ChatCompletionChunk:
+            # lands on the ambient judge span (we run in the pump task)
+            obs.annotate(judge_error=str(err))
             return ChatCompletionChunk(
                 id=resp_id,
                 choices=[
@@ -953,7 +1070,9 @@ class ScoreClient:
                     logprob_tokens,
                 )
                 choice.delta.vote = vote
+                obs.annotate(vote=[float(v) for v in vote])
             except InvalidContentError as e:
+                obs.annotate(vote_error=str(e))
                 if choice.error is None:
                     choice.error = to_response_error(e)
                     choice.finish_reason = "error"

@@ -29,6 +29,9 @@ class SSEParser:
     def __init__(self) -> None:
         self._buffer = bytearray()
         self._data_lines: list = []
+        # events dispatched over this parser's lifetime — read by the
+        # chat client at stream end as a judge-span trace attribute
+        self.events_parsed = 0
 
     def feed(self, data: bytes) -> Iterator[str]:
         """Consume a chunk of bytes; yield completed event payloads."""
@@ -51,6 +54,7 @@ class SSEParser:
             if self._data_lines:
                 event = "\n".join(self._data_lines)
                 self._data_lines = []
+                self.events_parsed += 1
                 return event
             return None
         if line.startswith(b":"):
@@ -80,6 +84,7 @@ class SSEParser:
         if self._data_lines:
             event = "\n".join(self._data_lines)
             self._data_lines = []
+            self.events_parsed += 1
             return event
         return None
 
@@ -141,6 +146,7 @@ class NativeSSEParser:
         if self._lib is None:
             raise RuntimeError("native SSE parser unavailable")
         self._handle = self._lib.sse_parser_new()
+        self.events_parsed = 0  # same contract as SSEParser
 
     def _drain(self) -> Iterator[str]:
         out_len = ctypes.c_size_t()
@@ -150,6 +156,7 @@ class NativeSSEParser:
             )
             if not ptr:
                 return
+            self.events_parsed += 1
             yield ctypes.string_at(ptr, out_len.value).decode(
                 "utf-8", errors="replace"
             )

@@ -24,6 +24,19 @@ from .types.base import ResponseError  # noqa: F401  (canonical home: type core)
 MASKING_LOGGER = "lwc.serve"
 
 
+def with_trace_id(envelope: dict) -> dict:
+    """Stamp the ambient request's trace id onto an error envelope (the
+    ``{code, message}`` dict or a mid-stream SSE error frame) so a
+    client holding a failure can hand the operator the exact trace.
+    No-op (and allocation-free) when tracing is off or inactive."""
+    from .obs import current_trace_id
+
+    trace_id = current_trace_id()
+    if trace_id is not None:
+        envelope["trace_id"] = trace_id
+    return envelope
+
+
 def _status_phrase(code: int) -> str:
     try:
         return f"{code} {HTTPStatus(code).phrase}"

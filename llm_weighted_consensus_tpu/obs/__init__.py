@@ -1,0 +1,32 @@
+"""End-to-end request tracing (ISSUE 5 / Dapper-style).
+
+``span`` — contextvar-carried span tree; ``sink`` — bounded ring +
+JSONL retention with head sampling and forced capture for degraded/
+shed/error requests; ``propagate`` — W3C ``traceparent`` inject at
+upstream calls / extract at the gateway door.
+
+The whole package is dependency-free below ``utils`` so any layer
+(cache, clients, batcher, resilience) can instrument without cycles.
+With no sink configured nothing ever activates a root span, and every
+ambient helper here is a single contextvar read returning None.
+"""
+
+from .propagate import (  # noqa: F401
+    TRACEPARENT_HEADER,
+    extract,
+    format_traceparent,
+    inject,
+    parse_traceparent,
+)
+from .sink import TraceSink  # noqa: F401
+from .span import (  # noqa: F401
+    Span,
+    Trace,
+    annotate,
+    child_span,
+    current_span,
+    current_trace_id,
+    force_keep,
+    span,
+    start_trace,
+)

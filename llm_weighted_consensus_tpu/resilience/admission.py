@@ -172,16 +172,20 @@ def shed_response(reason: str, retry_after_ms: float):
 
     from ..utils import jsonutil
 
+    from ..errors import with_trace_id
+
     return web.Response(
         status=503,
         headers={
             "Retry-After": str(max(1, math.ceil(retry_after_ms / 1000.0)))
         },
         text=jsonutil.dumps(
-            {
-                "code": 503,
-                "message": {"kind": "overloaded", "shed_reason": reason},
-            }
+            with_trace_id(
+                {
+                    "code": 503,
+                    "message": {"kind": "overloaded", "shed_reason": reason},
+                }
+            )
         ),
         content_type="application/json",
     )
@@ -194,15 +198,25 @@ def admission_middleware(admission: AdmissionController):
     any work happens."""
     from aiohttp import web
 
+    from ..obs import annotate as trace_annotate
+
     @web.middleware
     async def _mw(request, handler):
-        if request.path in EXEMPT_PATHS:
+        # /v1/traces rides the probe exemption: operators debugging an
+        # overload need to READ traces exactly while the gate sheds
+        if request.path in EXEMPT_PATHS or request.path.startswith(
+            "/v1/traces"
+        ):
             return await handler(request)
         reason = admission.try_acquire(
             device_work=request.path in DEVICE_PATHS
         )
         if reason is not None:
+            # lands on the gateway root span (the trace middleware wraps
+            # this one); the 503 status forces trace retention there
+            trace_annotate(shed_reason=reason)
             return shed_response(reason, admission.config.retry_after_ms)
+        trace_annotate(admission_inflight=admission.inflight)
         t0 = admission.clock()
         error = True
         try:

@@ -137,6 +137,13 @@ class CircuitBreaker:
             "opened_total": self.opened_total,
         }
 
+    def describe(self) -> str:
+        """One-token state summary for trace attributes — cheap enough to
+        stamp on every attempt span (``snapshot()`` walks the window)."""
+        if self.state == HALF_OPEN:
+            return f"half_open:{self._probes_in_flight}"
+        return self.state
+
 
 class BreakerRegistry:
     """Breakers keyed by ``api_base|model`` — the attempt-matrix unit.

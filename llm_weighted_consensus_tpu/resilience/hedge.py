@@ -89,3 +89,15 @@ class HedgePolicy:
 
     def observe(self, first_chunk_latency_ms: float) -> None:
         self.tracker.record(first_chunk_latency_ms)
+
+    def explain(self) -> dict:
+        """Why the hedge fired when it did — stamped on trace spans so a
+        surprising backup launch is attributable to the reservoir state
+        at decision time."""
+        delay = self.delay_ms_effective()
+        return {
+            "delay_ms_effective": round(delay, 3) if delay is not None else None,
+            "quantile": self.quantile,
+            "static_delay_ms": self.delay_ms,
+            "observations": self.tracker.total,
+        }

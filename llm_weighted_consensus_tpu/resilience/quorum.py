@@ -99,3 +99,20 @@ class QuorumTracker:
             default=Decimal(0),
         )
         return self.choice_weight[lead] > runner_up + self.remaining_weight
+
+    # -- observability --------------------------------------------------------
+
+    def explain(self) -> dict:
+        """The quorum decision as a trace attribute: why the fan-out was
+        (or was not) cut.  Decimals stringified so the record survives
+        JSON serialization without precision loss."""
+        return {
+            "settled_weight": str(self.settled_weight),
+            "total_weight": str(self.total_weight),
+            "remaining_weight": str(self.remaining_weight),
+            "leader": self.leader(),
+            "decided": self.decided(),
+            "voted": sorted(self.voted),
+            "errored": sorted(self.errored),
+            "pending": sorted(self.pending()),
+        }

@@ -936,6 +936,8 @@ def build_service(
         admission=admission,
         lifecycle=lifecycle,
         watchdog=watchdog,
+        # TRACE_*: request tracing (obs/); None preserves untraced behavior
+        trace_sink=config.trace_sink(),
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables

@@ -45,9 +45,9 @@ import numpy as np
 
 
 class _Item:
-    __slots__ = ("kind", "key", "payload", "future", "deadline")
+    __slots__ = ("kind", "key", "payload", "future", "deadline", "span")
 
-    def __init__(self, kind, key, payload, future, deadline=None):
+    def __init__(self, kind, key, payload, future, deadline=None, span=None):
         self.kind = kind
         self.key = key
         self.payload = payload
@@ -56,6 +56,11 @@ class _Item:
         # captured at submit so the pre-dispatch shed can drop work
         # that can no longer finish in time
         self.deadline = deadline
+        # the request's batcher span (obs/), captured at submit like the
+        # deadline: the flusher and _run_group run in long-lived tasks
+        # whose ambient context is stale, so device timing children hang
+        # off this explicit handle instead of contextvars
+        self.span = span
 
 
 class DeviceBatcher:
@@ -348,6 +353,11 @@ class DeviceBatcher:
     # -- internals -----------------------------------------------------------
 
     async def _submit(self, kind, key, payload):
+        from .. import obs
+
+        # enqueue -> result wall time for THIS request's item; created
+        # here (the submitting task still carries the request context)
+        span = obs.child_span(f"batcher:{kind}", queue_depth=len(self._pending))
         if (
             self.max_queue_depth
             and len(self._pending) >= self.max_queue_depth
@@ -360,6 +370,9 @@ class DeviceBatcher:
                 self.metrics.observe(
                     "device:shed:queue_full", 0.0, error=True
                 )
+            if span is not None:
+                span.annotate(shed="queue_full")
+                span.finish("error")
             from ..errors import OverloadedError
 
             raise OverloadedError("batcher_queue_full")
@@ -368,14 +381,17 @@ class DeviceBatcher:
         loop = asyncio.get_running_loop()
         future = loop.create_future()
         self._pending.append(
-            _Item(kind, key, payload, future, current_deadline())
+            _Item(kind, key, payload, future, current_deadline(), span)
         )
         if self._flusher is None or self._flusher.done():
             self._flusher = loop.create_task(self._drain())
         elif self._wake is not None:
             self._wake.set()  # unpark a flusher waiting on in-flight work
         try:
-            return await future
+            result = await future
+            if span is not None:
+                span.finish()
+            return result
         except BaseException:
             # the caller is gone (task cancellation, or a GeneratorExit
             # thrown into a streaming generator by the client
@@ -383,6 +399,8 @@ class DeviceBatcher:
             # dispatched item is dropped from its group instead of
             # burning device time on work nobody will read
             future.cancel()
+            if span is not None:
+                span.finish("error")
             raise
 
     async def _drain(self) -> None:
@@ -450,6 +468,9 @@ class DeviceBatcher:
                 if doomed:
                     from ..errors import DeadlineExceededError
 
+                    if item.span is not None:
+                        # finished by _submit when the exception lands
+                        item.span.annotate(shed="deadline")
                     item.future.set_exception(
                         DeadlineExceededError("shed before device dispatch")
                     )
@@ -466,6 +487,19 @@ class DeviceBatcher:
         t0 = time.perf_counter()
         token = object()
         self._inflight[token] = t0
+        # device wall-time children on each traced item's batcher span,
+        # bracketing exactly what the watchdog brackets (the executor
+        # hop + the PJRT call)
+        dspans = [
+            item.span.child(
+                "device:dispatch",
+                kind=item.kind,
+                batch_size=len(group),
+            )
+            for item in group
+            if item.span is not None
+        ]
+        error = False
         wd_token = (
             self.watchdog.begin(group[0].kind)
             if self.watchdog is not None
@@ -476,6 +510,7 @@ class DeviceBatcher:
                 self._executor, self._dispatch, group
             )
         except Exception as e:
+            error = True
             for item in group:
                 if not item.future.done():
                     item.future.set_exception(e)
@@ -488,6 +523,8 @@ class DeviceBatcher:
         finally:
             if wd_token is not None:
                 self.watchdog.end(wd_token)
+            for dspan in dspans:
+                dspan.finish("error" if error else None)
             self._sem.release()
 
     def _observe(self, group, t0, token, *, error: bool) -> None:
@@ -507,10 +544,22 @@ class DeviceBatcher:
                 ms if prev is None else 0.8 * prev + 0.2 * ms
             )
         if self.metrics is not None:
+            # exemplar: the first traced item in the group links this
+            # series to a concrete span tree (explicit handle — ambient
+            # reads would see the flusher task's stale context)
+            trace_id = next(
+                (
+                    item.span.trace.trace_id
+                    for item in group
+                    if item.span is not None
+                ),
+                None,
+            )
             self.metrics.observe(
                 f"device:batch:{group[0].kind}",
                 (end - t0) * 1e3,
                 error=error,
+                trace_id=trace_id,
             )
 
     @staticmethod

@@ -209,6 +209,29 @@ Shed/drain/watchdog counters and the inflight/queue-depth gauges
 surface as the ``admission`` / ``device_watchdog`` / ``lifecycle`` /
 ``device_batcher`` sections of ``GET /metrics``.  ``/healthz`` remains
 as a deprecated alias of the ``/livez`` + ``/readyz`` split.
+
+Tracing (obs/; all opt-in — with every ``TRACE_*`` knob unset no root
+span is ever created and the hot path pays one contextvar read):
+
+* ``TRACE_SAMPLE_RATE`` — head-based sampling probability in [0, 1]:
+  the gateway flips this coin once per request at the door.  Degraded,
+  shed and errored requests are ALWAYS captured once tracing is
+  enabled, regardless of the rate.  ``> 0`` enables tracing.
+* ``TRACE_ENABLED`` — ``1`` enables tracing even at rate 0 (capture
+  only the degraded/shed/error traces — the cheapest useful setting).
+* ``TRACE_RING`` — completed traces kept in memory for
+  ``GET /v1/traces`` (index) and ``GET /v1/traces/{trace_id}`` (full
+  span tree); oldest evicted first.  Default 256.
+* ``TRACE_DIR`` — optional JSONL disk tier: one JSON line per kept
+  trace appended to ``traces-<pid>.jsonl`` under this directory
+  (setting it also enables tracing).
+
+Incoming ``traceparent`` headers (W3C) are honored — the caller's
+trace id is adopted and its sampled flag forces capture — and every
+upstream judge call carries a ``traceparent`` naming the attempt span
+as parent.  Kept/dropped/forced counters surface as the ``traces``
+section of ``GET /metrics``; per-series ``trace_id`` exemplars ride
+the existing latency sections.
 """
 
 from __future__ import annotations
@@ -430,6 +453,14 @@ class Config:
     device_watchdog_millis: float = 0.0
     device_watchdog_interval_millis: float = 0.0  # 0 = auto (timeout/4)
     device_watchdog_cpu_fallback: bool = False
+    # request tracing (obs/): head-sample rate, forced-on flag (capture
+    # only degraded/shed/error at rate 0), ring capacity, JSONL dir.
+    # trace_sink() returns None when nothing enables tracing, keeping
+    # the untraced hot path at one contextvar read per helper call.
+    trace_sample_rate: float = 0.0
+    trace_enabled: bool = False
+    trace_ring: int = 256
+    trace_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -565,6 +596,10 @@ class Config:
             device_watchdog_cpu_fallback=env_truthy(
                 env.get("DEVICE_WATCHDOG_CPU_FALLBACK", "0")
             ),
+            trace_sample_rate=get_f("TRACE_SAMPLE_RATE", 0),
+            trace_enabled=env_truthy(env.get("TRACE_ENABLED", "0")),
+            trace_ring=max(1, int(env.get("TRACE_RING", 256))),
+            trace_dir=env.get("TRACE_DIR"),
         )
         if not 0 <= config.resilience_quorum <= 1:
             raise ValueError(
@@ -606,6 +641,11 @@ class Config:
                 "DEVICE_WATCHDOG_CPU_FALLBACK=1 needs "
                 "DEVICE_WATCHDOG_MILLIS > 0: without the watchdog nothing "
                 "ever routes work to the fallback"
+            )
+        if not 0 <= config.trace_sample_rate <= 1:
+            raise ValueError(
+                f"TRACE_SAMPLE_RATE={config.trace_sample_rate} must be a "
+                "probability in [0, 1]"
             )
         if config.warmup_r and not config.warmup:
             # same loud-failure contract as _parse_warmup: WARMUP_R names
@@ -699,3 +739,21 @@ class Config:
         from ..resilience import FaultPlan
 
         return FaultPlan.parse(self.fault_plan)
+
+    def trace_sink(self):
+        """The configured TraceSink, or None when nothing enables
+        tracing (None keeps every instrumentation site on its one-
+        contextvar-read no-op path — resilience_policy() discipline)."""
+        if not (
+            self.trace_enabled
+            or self.trace_sample_rate > 0
+            or self.trace_dir
+        ):
+            return None
+        from ..obs import TraceSink
+
+        return TraceSink(
+            capacity=self.trace_ring,
+            sample_rate=self.trace_sample_rate,
+            disk_dir=self.trace_dir,
+        )

@@ -13,11 +13,13 @@ from typing import Optional
 
 from aiohttp import web
 
+from .. import obs
 from ..errors import (
     OverloadedError,
     ScoreError,
     StatusError,
     to_response_error,
+    with_trace_id,
 )
 from .metrics import (
     Metrics,
@@ -59,11 +61,18 @@ def _error_response(e: Exception) -> web.Response:
                     max(1, math.ceil((e.retry_after_ms or 1000.0) / 1000.0))
                 )
             },
-            text=jsonutil.dumps({"code": 503, "message": e.message()}),
+            text=jsonutil.dumps(
+                with_trace_id({"code": 503, "message": e.message()})
+            ),
             content_type="application/json",
         )
     if isinstance(e, StatusError):
         status, message = e.status(), e.message()
+        if isinstance(message, dict):
+            # dict-shaped error payloads carry the request's trace id so
+            # a client-reported failure names its exact trace; string
+            # payloads keep the reference's constant messages untouched
+            message = with_trace_id(dict(message))
         body = jsonutil.dumps(message)
     else:
         # Uniform {code, message} envelope for unexpected failures; ONE
@@ -72,7 +81,7 @@ def _error_response(e: Exception) -> web.Response:
         # same as the mid-stream frame path in _respond_streaming.
         err = to_response_error(e)
         status = err.code
-        body = jsonutil.dumps(err.to_json_obj())
+        body = jsonutil.dumps(with_trace_id(err.to_json_obj()))
     return web.Response(
         status=status, text=body, content_type="application/json"
     )
@@ -88,7 +97,10 @@ async def _respond_streaming(request: web.Request, stream) -> web.StreamResponse
     try:
         async for item in stream:
             if isinstance(item, Exception):
-                payload = to_response_error(item).to_json_obj()
+                # a mid-stream error makes this trace worth keeping even
+                # when head sampling said no (sink.py retention rule)
+                obs.force_keep("stream_error")
+                payload = with_trace_id(to_response_error(item).to_json_obj())
             else:
                 payload = item.to_json_obj()
             await resp.write(_frame(payload))
@@ -100,6 +112,7 @@ async def _respond_streaming(request: web.Request, stream) -> web.StreamResponse
         # upstream judge pumps and any batcher futures this request has
         # in flight (batcher._submit drops a cancelled item before its
         # group dispatches — no orphaned device work)
+        obs.annotate(client_disconnect=True)
         metrics = request.app.get(METRICS_KEY)
         if metrics is not None:
             metrics.observe("http:client_disconnect", 0.0, error=True)
@@ -132,7 +145,7 @@ def _parse_error_response(e: Exception) -> web.Response:
         message = "malformed request body"
     return web.Response(
         status=400,
-        text=jsonutil.dumps({"code": 400, "message": message}),
+        text=jsonutil.dumps(with_trace_id({"code": 400, "message": message})),
         content_type="application/json",
     )
 
@@ -165,6 +178,88 @@ def deadline_middleware(resilience):
             Deadline.deactivate(token)
 
     return _mw
+
+
+# probes and the trace read endpoints are never themselves traced — a
+# poller scraping /metrics must not churn the sampling budget, and
+# reading traces must not mint traces
+TRACE_EXEMPT_PATHS = frozenset({"/healthz", "/livez", "/readyz", "/metrics"})
+
+
+def trace_middleware(sink):
+    """The gateway door of the obs/ subsystem: extract an upstream
+    ``traceparent`` (external callers stitch our tree under theirs),
+    flip the head-sampling coin, run the whole request — middlewares
+    included, so admission sheds land inside the root span — and offer
+    the finished trace to the sink, which keeps it when sampled or when
+    the outcome forced retention (5xx, shed, degraded, stream error)."""
+
+    @web.middleware
+    async def _mw(request, handler):
+        if request.path in TRACE_EXEMPT_PATHS or request.path.startswith(
+            "/v1/traces"
+        ):
+            return await handler(request)
+        upstream = obs.extract(request.headers)
+        if upstream is not None:
+            trace_id, parent_span_id, caller_sampled = upstream
+            sampled = caller_sampled or sink.sample()
+        else:
+            trace_id = parent_span_id = None
+            sampled = sink.sample()
+        root = obs.start_trace(
+            f"gateway:{request.method} {request.path}",
+            sampled=sampled,
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
+        )
+        token = root.activate()
+        status: Optional[int] = None
+        try:
+            resp = await handler(request)
+            status = resp.status
+            if not resp.prepared:
+                resp.headers["x-trace-id"] = root.trace.trace_id
+            return resp
+        except Exception as e:
+            root.set_error(e)
+            raise
+        finally:
+            if status is not None:
+                root.annotate(http_status=status)
+                if status >= 500:
+                    # sheds return their 503 rather than raising — the
+                    # admission middleware annotated shed_reason already
+                    root.status = "error"
+                    root.trace.force(f"http_{status}")
+            obs.Span.deactivate(token)
+            root.finish()
+            sink.offer(root.trace)
+
+    return _mw
+
+
+def _trace_handlers(sink):
+    """GET /v1/traces (recent index) + GET /v1/traces/{trace_id}."""
+
+    async def index(request: web.Request):
+        try:
+            limit = int(request.query.get("limit", 50))
+        except ValueError:
+            limit = 50
+        return web.json_response(
+            {"traces": sink.index(limit=max(1, min(limit, sink.capacity)))}
+        )
+
+    async def get_one(request: web.Request):
+        record = sink.get(request.match_info["trace_id"])
+        if record is None:
+            return web.json_response(
+                {"code": 404, "message": "unknown trace_id"}, status=404
+            )
+        return web.json_response(record)
+
+    return index, get_one
 
 
 def _make_handler(params_cls, create_streaming, create_unary):
@@ -355,6 +450,7 @@ def build_app(
     admission=None,
     lifecycle=None,
     watchdog=None,
+    trace_sink=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
     register_resilience(metrics, resilience, fault_plan)
@@ -391,7 +487,14 @@ def build_app(
             return stats
 
         metrics.register_provider("score_cache", _score_cache_stats)
-    middlewares = [middleware(metrics)]
+    middlewares = []
+    if trace_sink is not None:
+        # outermost: the root span brackets everything, and the metrics
+        # middleware inside it observes with the ambient trace active
+        # (that read is where the per-series trace_id exemplars come from)
+        middlewares.append(trace_middleware(trace_sink))
+        metrics.register_provider("traces", trace_sink.snapshot)
+    middlewares.append(middleware(metrics))
     if admission is not None:
         # inside metrics (sheds are observable per route), outside the
         # deadline stamp (shed work should not even start a budget)
@@ -463,6 +566,10 @@ def build_app(
     app.router.add_get("/livez", livez)
     app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", metrics_handler)
+    if trace_sink is not None:
+        traces_index, traces_get = _trace_handlers(trace_sink)
+        app.router.add_get("/v1/traces", traces_index)
+        app.router.add_get("/v1/traces/{trace_id}", traces_get)
     if profile_dir:
         start, stop = _profile_handlers(profile_dir)
         app.router.add_post("/profile/start", start)

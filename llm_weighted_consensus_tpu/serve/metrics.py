@@ -22,13 +22,29 @@ class Metrics:
         self._errors: dict = {}
         self._latencies: dict = {}
         self._providers: dict = {}
+        self._exemplars: dict = {}
         self._started = time.time()
 
-    def observe(self, series: str, ms: float, *, error: bool = False) -> None:
+    def observe(
+        self,
+        series: str,
+        ms: float,
+        *,
+        error: bool = False,
+        trace_id=None,
+    ) -> None:
         self._counts[series] = self._counts.get(series, 0) + 1
         if error:
             self._errors[series] = self._errors.get(series, 0) + 1
         self._latencies.setdefault(series, deque(maxlen=_RESERVOIR)).append(ms)
+        if trace_id is not None:
+            # trace-id exemplar (Prometheus-exemplar analog): the most
+            # recent traced request on this series — an aggregate that
+            # looks wrong links straight to one concrete span tree.
+            # Passed EXPLICITLY by call sites that know the right trace
+            # (ambient reads here would pick up stale contexts from
+            # long-lived tasks like the batcher's flusher).
+            self._exemplars[series] = trace_id
 
     def register_provider(self, name: str, fn) -> None:
         """Attach a live gauge section to the snapshot (e.g. the device
@@ -45,6 +61,9 @@ class Metrics:
                 entry["p99_ms"] = round(
                     lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2
                 )
+            exemplar = self._exemplars.get(series)
+            if exemplar is not None:
+                entry["trace_id"] = exemplar
             out[series] = entry
         snap = {
             "uptime_sec": round(time.time() - self._started, 1),
@@ -102,8 +121,13 @@ def _series(request) -> str:
 
 
 def middleware(metrics: Metrics):
-    """aiohttp middleware timing every request by matched route."""
+    """aiohttp middleware timing every request by matched route.  Runs
+    inside the trace middleware (serve/gateway.py orders it so), hence
+    the ambient trace — when one is active — becomes the series'
+    exemplar."""
     from aiohttp import web
+
+    from ..obs import current_trace_id
 
     @web.middleware
     async def _mw(request, handler):
@@ -115,12 +139,14 @@ def middleware(metrics: Metrics):
                 _series(request),
                 (time.perf_counter() - t0) * 1e3,
                 error=True,
+                trace_id=current_trace_id(),
             )
             raise
         metrics.observe(
             _series(request),
             (time.perf_counter() - t0) * 1e3,
             error=resp.status >= 400,
+            trace_id=current_trace_id(),
         )
         return resp
 

@@ -336,35 +336,41 @@ def _build_push(cls):
     )
 
 
-def _field_spec(cls, f):
-    try:
-        return f.metadata["spec"]
-    except KeyError:
-        raise TypeError(
-            f"{cls.__name__}.{f.name} was declared without the field() "
-            "helper (no codec spec in metadata) — it can be pushed/cloned "
-            "but not (de)serialized"
-        ) from None
+def _speccless_error(cls, name):
+    return TypeError(
+        f"{cls.__name__}.{name} was declared without the field() "
+        "helper (no codec spec in metadata) — it can be pushed/cloned "
+        "but not (de)serialized"
+    )
 
 
 def _build_encode(cls):
+    # a spec-less field (declared without the field() helper — push/clone-
+    # only state) stays in the plan with a None spec sentinel: encoding is
+    # fine while its value is None (nothing to emit), and raises the
+    # declaration error only when a real value would need a codec.
+    # Raising at plan-build time instead would poison to_json_obj for the
+    # WHOLE class the first time any instance serialized, even if the
+    # spec-less field was never set.
     return tuple(
         (
             f.name,
             f.metadata.get("json_name") or f.name,
             f.metadata.get("skip_if_none", True),
-            _field_spec(cls, f),
+            f.metadata.get("spec"),
         )
         for f in dataclasses.fields(cls)
     )
 
 
 def _build_decode(cls):
+    # spec-less fields are excluded outright: incoming JSON can't target
+    # them (no json name contract), so they simply keep their default
     return tuple(
         (
             f.name,
             f.metadata.get("json_name") or f.name,
-            _field_spec(cls, f),
+            f.metadata["spec"],
             bool(f.metadata.get("required"))
             or (
                 f.default is dataclasses.MISSING
@@ -372,6 +378,7 @@ def _build_decode(cls):
             ),
         )
         for f in dataclasses.fields(cls)
+        if "spec" in f.metadata
     )
 
 
@@ -391,6 +398,11 @@ class Struct:
             value = getattr(self, attr)
             if value is None and skip_if_none:
                 continue
+            if spec is None:
+                # spec-less (push/clone-only) field holding a real value:
+                # there is no codec to render it with — refuse loudly
+                # instead of emitting something json.dumps will mangle
+                raise _speccless_error(type(self), attr)
             out[name] = _encode(spec, value)
         return out
 

@@ -16,6 +16,7 @@ from .base import ResponseError
 from .base import (
     Const,
     EXTEND,
+    FIRST,
     KEEP,
     KEYED,
     List,
@@ -182,6 +183,12 @@ class ChatCompletionChunk(Struct):
     # without the full panel — weight-quorum early exit or deadline expiry
     # with a partial panel; absent entirely from healthy responses
     degraded: Optional[bool] = field(bool, default=None, merge=KEEP)
+    # set on the final aggregate frame when the request is traced: the key
+    # into GET /v1/traces/{trace_id} for the consensus explain record.
+    # Absent on untraced requests and on cache replays (the recording
+    # strips the leader's id — see cache/store.py).  FIRST, not KEEP, so
+    # fold_chunks carries it from the final frame into the unary fold.
+    trace_id: Optional[str] = field(str, default=None, merge=FIRST)
 
     def tool_as_content(self) -> None:
         for choice in self.choices:
@@ -294,6 +301,7 @@ class ChatCompletion(Struct):
     # custom field
     weight_data: object = field(WEIGHT_DATA, default=None, skip_if_none=False)
     degraded: Optional[bool] = field(bool, default=None)
+    trace_id: Optional[str] = field(str, default=None)
 
     @classmethod
     def from_streaming(cls, chunk: ChatCompletionChunk) -> "ChatCompletion":
@@ -306,4 +314,5 @@ class ChatCompletion(Struct):
             usage=chunk.usage,
             weight_data=chunk.weight_data,
             degraded=chunk.degraded,
+            trace_id=chunk.trace_id,
         )

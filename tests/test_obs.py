@@ -1,0 +1,722 @@
+"""Request tracing (obs/): span tree correctness under concurrency,
+sink retention policy (sampling vs forced capture), W3C traceparent
+propagation gateway->upstream, and the consensus explain trace."""
+
+import asyncio
+import json
+import random
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_weighted_consensus_tpu import archive, obs, registry
+from llm_weighted_consensus_tpu.clients.chat import (
+    ApiBase,
+    BackoffPolicy,
+    DefaultChatClient,
+)
+from llm_weighted_consensus_tpu.clients.multichat import MultichatClient
+from llm_weighted_consensus_tpu.clients.score import ScoreClient
+from llm_weighted_consensus_tpu.cache import ScoreCache, SingleFlight
+from llm_weighted_consensus_tpu.identity.model import ModelBase
+from llm_weighted_consensus_tpu.obs import (
+    TraceSink,
+    format_traceparent,
+    parse_traceparent,
+)
+from llm_weighted_consensus_tpu.resilience import (
+    BreakerConfig,
+    BreakerRegistry,
+    HedgePolicy,
+    ResiliencePolicy,
+)
+from llm_weighted_consensus_tpu.serve import build_app
+from llm_weighted_consensus_tpu.serve.batcher import DeviceBatcher
+from llm_weighted_consensus_tpu.types.score_request import (
+    ChatCompletionCreateParams as ScoreParams,
+)
+from llm_weighted_consensus_tpu.utils import jsonutil
+
+from fakes import FakeTransport, Script, chunk_obj
+
+SEED = 42
+NO_RETRY = BackoffPolicy(max_elapsed_ms=0)
+AB = [
+    ApiBase("https://a.example", "key-a"),
+    ApiBase("https://b.example", "key-b"),
+]
+TEXTS = ["answer alpha", "answer beta", "answer gamma"]
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def make_model(judges):
+    return ModelBase.from_json_obj({"llms": judges}).into_model_validate()
+
+
+def inline_model_json(model):
+    return {"llms": [llm.base.to_json_obj() for llm in model.llms]}
+
+
+def ballot_keys(n):
+    from llm_weighted_consensus_tpu.ballot import PrefixTree, branch_limit
+
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, n, branch_limit(None))
+    return {idx: key for key, idx in tree.key_indices(rng)}
+
+
+def judge_script(key, **kw):
+    return Script(
+        [
+            chunk_obj("I pick ", model="up-model"),
+            chunk_obj(f"{key} as best.", model="up-model", finish="stop"),
+        ],
+        **kw,
+    )
+
+
+def score_params(choices, model, **kw):
+    return ScoreParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "pick the best"}],
+            "model": model,
+            "choices": choices,
+            **kw,
+        }
+    )
+
+
+def make_score_client(scripts, policy=None, api_bases=None, **kw):
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport,
+        api_bases or AB[:1],
+        backoff=NO_RETRY,
+        resilience=policy,
+    )
+    client = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+        resilience=policy,
+        **kw,
+    )
+    return client, transport
+
+
+async def collect(client, params):
+    stream = await client.create_streaming(None, params)
+    return [item async for item in stream]
+
+
+async def traced(fn, sampled=True):
+    """Run ``fn`` under a fresh activated root span; returns
+    (trace, result) so tests can inspect the whole tree."""
+    root = obs.start_trace("test:root", sampled=sampled)
+    token = root.activate()
+    try:
+        result = await fn()
+    finally:
+        obs.Span.deactivate(token)
+        root.finish()
+    return root.trace, result
+
+
+def by_name(trace, name):
+    return [s for s in trace.spans if s.name == name]
+
+
+# -- span tree ----------------------------------------------------------------
+
+
+def test_span_tree_parent_ids_and_render():
+    root = obs.start_trace("gateway:POST /x", sampled=True, route="/x")
+    a = root.child("cache:lookup")
+    b = a.child("singleflight:wait")
+    a.finish()
+    b.finish()
+    root.finish()
+    trace = root.trace
+
+    assert root.parent_id is None
+    assert a.parent_id == root.span_id
+    assert b.parent_id == a.span_id
+    assert len({s.span_id for s in trace.spans}) == 3
+    record = trace.to_json_obj()
+    assert record["name"] == "gateway:POST /x"
+    assert record["status"] == "ok"
+    assert len(record["spans"]) == 3
+    spans = {s["name"]: s for s in record["spans"]}
+    assert spans["cache:lookup"]["parent_id"] == root.span_id
+    assert spans["cache:lookup"]["duration_ms"] is not None
+    assert spans["gateway:POST /x"]["attributes"] == {"route": "/x"}
+    # ids are W3C-shaped: 32-hex trace, 16-hex spans, never all-zero
+    assert len(trace.trace_id) == 32 and trace.trace_id != "0" * 32
+    assert all(len(s.span_id) == 16 for s in trace.spans)
+
+
+def test_tracing_off_is_noop():
+    # no activated root: every ambient helper must short-circuit
+    assert obs.current_span() is None
+    assert obs.current_trace_id() is None
+    assert obs.child_span("anything") is None
+    obs.annotate(ignored=True)  # must not raise
+    obs.force_keep("ignored")
+    with obs.span("scope") as s:
+        assert s is None
+
+
+def test_span_scope_exception_forces_cancellation_does_not():
+    root = obs.start_trace("r", sampled=False)
+    token = root.activate()
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("kaput")
+    assert root.trace.forced
+    assert root.trace.force_reason == "error:boom"
+    errored = by_name(root.trace, "boom")[0]
+    assert errored.status == "error"
+    assert "kaput" in errored.attributes["error"]
+
+    root2 = obs.start_trace("r2", sampled=False)
+    obs.Span.deactivate(token)
+    token2 = root2.activate()
+    with pytest.raises(asyncio.CancelledError):
+        with obs.span("gone"):
+            raise asyncio.CancelledError()
+    obs.Span.deactivate(token2)
+    # a disconnect marks the span but never forces whole-trace retention
+    assert not root2.trace.forced
+    gone = by_name(root2.trace, "gone")[0]
+    assert gone.status == "error"
+    assert gone.attributes.get("cancelled") is True
+
+
+def test_concurrent_traces_do_not_cross_contaminate():
+    async def one_request(n):
+        root = obs.start_trace(f"req-{n}", sampled=True)
+        token = root.activate()
+        try:
+            for hop in range(5):
+                await asyncio.sleep(random.Random(n * 31 + hop).random() / 200)
+                assert obs.current_trace_id() == root.trace.trace_id
+                child = obs.child_span(f"hop-{hop}")
+                child.finish()
+
+            async def subtask():
+                # tasks copy context at creation: the child task sees
+                # ITS request's trace, never a neighbor's
+                await asyncio.sleep(0)
+                assert obs.current_trace_id() == root.trace.trace_id
+                return obs.child_span("sub")
+
+            sub = await asyncio.create_task(subtask())
+            sub.finish()
+        finally:
+            obs.Span.deactivate(token)
+            root.finish()
+        return root.trace
+
+    async def run():
+        return await asyncio.gather(*(one_request(n) for n in range(8)))
+
+    traces = go(run())
+    ids = {t.trace_id for t in traces}
+    assert len(ids) == 8
+    for t in traces:
+        assert len(t.spans) == 7  # root + 5 hops + sub
+        assert all(s.trace is t for s in t.spans)
+
+
+# -- sink retention -----------------------------------------------------------
+
+
+def _done_trace(sampled=False, forced_reason=None):
+    root = obs.start_trace("t", sampled=sampled)
+    if forced_reason is not None:
+        root.trace.force(forced_reason)
+    root.finish()
+    return root.trace
+
+
+def test_sink_ring_bounded_and_recent_first():
+    sink = TraceSink(capacity=3, sample_rate=1.0)
+    traces = [_done_trace(sampled=True) for _ in range(5)]
+    for t in traces:
+        sink.offer(t)
+    assert sink.snapshot()["size"] == 3
+    index = sink.index()
+    assert [e["trace_id"] for e in index] == [
+        traces[4].trace_id, traces[3].trace_id, traces[2].trace_id
+    ]
+    assert sink.get(traces[0].trace_id) is None  # evicted oldest-first
+    assert sink.get(traces[4].trace_id)["trace_id"] == traces[4].trace_id
+    assert sink.index(limit=1) == index[:1]
+
+
+def test_sink_sampling_drop_and_forced_keep():
+    sink = TraceSink(capacity=8, sample_rate=0.0)
+    sink.offer(_done_trace(sampled=False))
+    assert sink.snapshot()["size"] == 0 and sink.dropped == 1
+    # degraded / shed / error outcomes force retention past the sampler
+    forced = _done_trace(sampled=False, forced_reason="degraded")
+    sink.offer(forced)
+    assert sink.get(forced.trace_id)["force_reason"] == "degraded"
+    assert sink.kept == 1 and sink.forced == 1
+    assert sink.sample() is False
+    assert TraceSink(sample_rate=1.0).sample() is True
+
+
+def test_sink_disk_jsonl(tmp_path):
+    sink = TraceSink(capacity=2, sample_rate=1.0, disk_dir=str(tmp_path))
+    kept = [_done_trace(sampled=True) for _ in range(3)]
+    for t in kept:
+        sink.offer(t)
+    sink.offer(_done_trace(sampled=False))  # dropped: must NOT hit disk
+    files = list(tmp_path.glob("traces-*.jsonl"))
+    assert len(files) == 1
+    lines = [json.loads(l) for l in files[0].read_text().splitlines()]
+    # disk keeps everything offered-and-kept, even after ring eviction
+    assert [l["trace_id"] for l in lines] == [t.trace_id for t in kept]
+
+
+# -- traceparent --------------------------------------------------------------
+
+
+def test_traceparent_parse_and_format():
+    tid, sid = "a" * 32, "b" * 16
+    assert parse_traceparent(format_traceparent(tid, sid, True)) == (
+        tid, sid, True
+    )
+    assert parse_traceparent(format_traceparent(tid, sid, False)) == (
+        tid, sid, False
+    )
+    assert parse_traceparent(f"00-{tid}-{sid}-03") == (tid, sid, True)
+    # malformed = treated as absent, never an error
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent(f"ff-{tid}-{sid}-01") is None  # version ff
+    assert parse_traceparent(f"00-{'0' * 32}-{sid}-01") is None
+    assert parse_traceparent(f"00-{tid}-{'0' * 16}-01") is None
+    assert parse_traceparent(f"00-{tid[:-1]}-{sid}-01") is None
+    assert parse_traceparent(f"00-{tid}-{sid}-zz") is None
+    # uppercase is normalized, future versions with extra fields accepted
+    assert parse_traceparent(f"00-{tid.upper()}-{sid}-01-extra") == (
+        tid, sid, True
+    )
+
+
+def test_inject_stamps_ambient_span():
+    headers = {}
+    obs.inject(headers)
+    assert headers == {}  # tracing off: no header
+    root = obs.start_trace("r", sampled=True)
+    token = root.activate()
+    try:
+        obs.inject(headers)
+    finally:
+        obs.Span.deactivate(token)
+    parsed = parse_traceparent(headers[obs.TRACEPARENT_HEADER])
+    assert parsed == (root.trace.trace_id, root.span_id, True)
+
+
+# -- score client: judge/tally spans, hedge children, explain record ----------
+
+
+def test_score_trace_judges_attempts_and_explain():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(breakers=BreakerRegistry(BreakerConfig()))
+    model = make_model(
+        [
+            {"model": "judge-a", "weight": {"type": "static", "weight": 2}},
+            {"model": "judge-b", "weight": {"type": "static", "weight": 1}},
+        ]
+    )
+    client, transport = make_score_client(
+        [judge_script(keys[1]), judge_script(keys[1])], policy
+    )
+    params = score_params(TEXTS, inline_model_json(model))
+    trace, items = go(traced(lambda: collect(client, params)))
+
+    # one judge:stream span per panel member, each with >= 1 attempt child
+    judges = by_name(trace, "judge:stream")
+    assert {s.attributes["model"] for s in judges} == {
+        l.id for l in model.llms
+    }
+    assert all(s.duration_ms() is not None for s in judges)
+    attempts = by_name(trace, "judge:attempt")
+    assert len(attempts) == 2
+    parents = {s.span_id for s in judges}
+    assert all(a.parent_id in parents for a in attempts)
+    # breaker annotation rides every attempt when breakers are wired
+    assert all(a.attributes["breaker_state"] == "closed" for a in attempts)
+    # cache front door ran (bypass: no cache configured)
+    cache_spans = by_name(trace, "cache:lookup")
+    assert [s.attributes["outcome"] for s in cache_spans] == ["bypass"]
+
+    # the tally span IS the explain record
+    tally = by_name(trace, "consensus:tally")[0]
+    assert tally.attributes["n_judges"] == 2
+    assert tally.attributes["winner"] == 1
+    assert tally.attributes["weight_sum"] == 3.0
+    assert tally.attributes["degraded"] is False
+    judges_ex = {j["model_index"]: j for j in tally.attributes["judges"]}
+    a_index = next(l.index for l in model.llms if l.base.model == "judge-a")
+    assert judges_ex[a_index]["weight"] == 2.0
+    assert judges_ex[a_index]["vote"][1] == 1.0
+    assert judges_ex[a_index]["confidence_contribution"] == 1.0
+    assert judges_ex[a_index]["error"] is None
+    cand = {c["index"]: c for c in tally.attributes["candidates"]}
+    assert cand[1]["weight"] == 3.0 and cand[1]["confidence"] == 1.0
+    assert cand[0]["weight"] == 0.0
+
+    # the final frame carries the trace id for /v1/traces retrieval
+    assert items[-1].trace_id == trace.trace_id
+    # upstream judge calls carry our context (traceparent inject)
+    for _, headers, _ in transport.requests:
+        tid, psid, sampled = parse_traceparent(headers["traceparent"])
+        assert tid == trace.trace_id and sampled
+        assert psid in {a.span_id for a in attempts}
+
+
+def test_score_trace_hedge_attempt_children():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(hedge=HedgePolicy(delay_ms=30.0))
+    model = make_model(
+        [{"model": "judge-a", "weight": {"type": "static", "weight": 1}}]
+    )
+    # primary stalls past the hedge delay; the backup wins the race
+    client, transport = make_score_client(
+        [judge_script(keys[1], delays={0: 1.0}), judge_script(keys[1])],
+        policy,
+        api_bases=AB,
+    )
+    params = score_params(TEXTS, inline_model_json(model))
+    trace, _ = go(traced(lambda: collect(client, params)))
+
+    judge = by_name(trace, "judge:stream")[0]
+    attempts = by_name(trace, "judge:attempt")
+    # both racers are children of the ONE judge span — hedged attempts
+    # stay distinguishable (different api_base) in the same subtree
+    assert len(attempts) == 2
+    assert all(a.parent_id == judge.span_id for a in attempts)
+    assert {a.attributes["api_base"] for a in attempts} == {
+        "https://a.example", "https://b.example"
+    }
+    assert judge.attributes["hedge_launched"] is True
+    assert judge.attributes["hedge"]["static_delay_ms"] == 30.0
+    # each attempt injected ITS OWN span id upstream
+    parent_ids = {
+        parse_traceparent(h["traceparent"])[1]
+        for _, h, _ in transport.requests
+    }
+    assert parent_ids == {a.span_id for a in attempts}
+
+
+def test_quorum_degraded_forces_retention_at_zero_sampling():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(quorum_fraction=0.5)
+    model = make_model(
+        [
+            {"model": "judge-a", "weight": {"type": "static", "weight": 2}},
+            {"model": "judge-b", "weight": {"type": "static", "weight": 1}},
+            {"model": "judge-c", "weight": {"type": "static", "weight": 1}},
+        ]
+    )
+    by_model = {
+        "judge-a": judge_script(keys[1]),
+        "judge-b": judge_script(keys[1]),
+        "judge-c": judge_script(keys[1], delays={0: 30.0}),
+    }
+    client, _ = make_score_client(
+        [by_model[llm.base.model] for llm in model.llms], policy
+    )
+    params = score_params(TEXTS, inline_model_json(model))
+    trace, items = go(traced(lambda: collect(client, params), sampled=False))
+
+    assert items[-1].degraded is True
+    # head sampling said no, the degraded outcome overrides it
+    assert not trace.sampled
+    assert trace.forced and trace.force_reason == "degraded"
+    sink = TraceSink(sample_rate=0.0)
+    sink.offer(trace)
+    assert sink.get(trace.trace_id) is not None
+    tally = by_name(trace, "consensus:tally")[0]
+    assert tally.attributes["degraded"] is True
+    c_index = next(l.index for l in model.llms if l.base.model == "judge-c")
+    straggler = [
+        j
+        for j in tally.attributes["judges"]
+        if j["model_index"] == c_index
+    ][0]
+    assert straggler["vote"] is None and straggler["error"] == 499
+    # the quorum explain annotation landed on the ambient span
+    quorum = trace.spans[0].attributes["quorum"]
+    assert quorum["decided"] is True
+    assert sorted(quorum["voted"]) != []
+
+
+def test_cache_lookup_spans_and_replay_scrubs_trace_id():
+    keys = ballot_keys(3)
+    model = make_model(
+        [{"model": "judge-a", "weight": {"type": "static", "weight": 1}}]
+    )
+    client, _ = make_score_client(
+        [judge_script(keys[1])],
+        cache=ScoreCache(60, 1 << 20),
+        flights=SingleFlight(),
+    )
+    params = score_params(TEXTS, inline_model_json(model))
+    t1, live = go(traced(lambda: collect(client, params)))
+    assert by_name(t1, "cache:lookup")[0].attributes["outcome"] == "leader"
+    assert live[-1].trace_id == t1.trace_id
+
+    t2, replay = go(traced(lambda: collect(client, params)))
+    assert by_name(t2, "cache:lookup")[0].attributes["outcome"] == "hit"
+    assert by_name(t2, "judge:stream") == []  # no upstream fan-out on a hit
+    # the leader's trace id must not leak into another request's replay
+    assert replay[-1].trace_id is None
+    final = replay[-1].to_json_obj()
+    assert "trace_id" not in final
+
+
+# -- batcher / device spans ---------------------------------------------------
+
+
+class NullEmbedder:
+    """Minimal device stand-in: enough surface for kind=embed dispatch."""
+
+    model_name = "null"
+
+    def tokenize(self, texts, max_tokens=None):
+        n = len(texts)
+        return (
+            np.zeros((n, 4), dtype=np.int32),
+            np.ones((n, 4), dtype=np.int32),
+        )
+
+    def embed_tokens(self, ids, mask):
+        return np.zeros((ids.shape[0], 8), dtype=np.float32)
+
+
+def test_batcher_and_device_dispatch_spans():
+    batcher = DeviceBatcher(NullEmbedder(), window_ms=5.0)
+
+    async def run():
+        return await asyncio.gather(
+            batcher.embed(["one", "two"]), batcher.embed(["three"])
+        )
+
+    trace, _ = go(traced(run))
+    queued = by_name(trace, "batcher:embed")
+    assert len(queued) == 2
+    assert all(s.status == "ok" and s.duration_ms() is not None for s in queued)
+    dispatches = by_name(trace, "device:dispatch")
+    # both items fused into one dispatch: each batcher span gets its own
+    # device child reporting the SHARED batch size
+    assert len(dispatches) == 2
+    assert {d.parent_id for d in dispatches} == {s.span_id for s in queued}
+    assert all(d.attributes["batch_size"] == 2 for d in dispatches)
+    assert all(d.attributes["kind"] == "embed" for d in dispatches)
+
+
+# -- gateway: /v1/traces, traceparent at the door, forced error capture -------
+
+
+def make_traced_app(scripts, sink, policy=None):
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        transport,
+        [ApiBase("https://up.example", "k")],
+        backoff=NO_RETRY,
+        resilience=policy,
+    )
+    reg = registry.InMemoryModelRegistry()
+    store = archive.InMemoryArchive()
+    score = ScoreClient(
+        chat,
+        reg,
+        archive_fetcher=store,
+        rng_factory=lambda: random.Random(SEED),
+        resilience=policy,
+    )
+    multichat = MultichatClient(chat, reg, archive_fetcher=store)
+    return build_app(chat, score, multichat, trace_sink=sink), transport
+
+
+async def with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def post_json(client, path, obj):
+    return client.post(
+        path,
+        data=jsonutil.dumps(obj),
+        headers={"content-type": "application/json"},
+    )
+
+
+def score_body(model, stream=False):
+    return {
+        "messages": [{"role": "user", "content": "pick the best"}],
+        "model": inline_model_json(model),
+        "choices": TEXTS,
+        "stream": stream,
+    }
+
+
+def two_judge_model():
+    return make_model(
+        [
+            {"model": "judge-a", "weight": {"type": "static", "weight": 2}},
+            {"model": "judge-b", "weight": {"type": "static", "weight": 1}},
+        ]
+    )
+
+
+def test_gateway_scored_request_trace_retrievable():
+    keys = ballot_keys(3)
+    sink = TraceSink(sample_rate=1.0)
+    policy = ResiliencePolicy(breakers=BreakerRegistry(BreakerConfig()))
+    app, _ = make_traced_app(
+        [judge_script(keys[1]), judge_script(keys[1])], sink, policy
+    )
+
+    async def run(client):
+        resp = await post_json(
+            client, "/score/completions", score_body(two_judge_model())
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        trace_id = resp.headers["x-trace-id"]
+        # the unary fold carries the final frame's trace id
+        assert body["trace_id"] == trace_id
+
+        index = await (await client.get("/v1/traces")).json()
+        assert [e["trace_id"] for e in index["traces"]] == [trace_id]
+        record = await (await client.get(f"/v1/traces/{trace_id}")).json()
+        return record
+
+    record = go(with_client(app, run))
+    assert record["sampled"] is True
+    names = [s["name"] for s in record["spans"]]
+    # gateway root -> cache front door -> M judge subtrees -> tally
+    assert names[0] == "gateway:POST /score/completions"
+    assert record["spans"][0]["parent_id"] is None
+    assert names.count("judge:stream") == 2
+    assert names.count("judge:attempt") == 2
+    assert "cache:lookup" in names
+    tally = [s for s in record["spans"] if s["name"] == "consensus:tally"][0]
+    assert len(tally["attributes"]["judges"]) == 2
+    assert tally["attributes"]["winner"] == 1
+    attempt = [s for s in record["spans"] if s["name"] == "judge:attempt"][0]
+    assert attempt["attributes"]["breaker_state"] == "closed"
+
+
+def test_gateway_sse_final_frame_carries_trace_id():
+    keys = ballot_keys(3)
+    sink = TraceSink(sample_rate=1.0)
+    app, _ = make_traced_app(
+        [judge_script(keys[1]), judge_script(keys[1])], sink
+    )
+
+    async def run(client):
+        resp = await post_json(
+            client,
+            "/score/completions",
+            score_body(two_judge_model(), stream=True),
+        )
+        assert resp.status == 200
+        events = [
+            block[len("data: "):]
+            for block in (await resp.text()).split("\n\n")
+            if block.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        return json.loads(events[-2])
+
+    final = go(with_client(app, run))
+    assert final["weight_data"] is not None
+    assert sink.get(final["trace_id"]) is not None
+
+
+def test_gateway_traceparent_adopted_and_propagated_upstream():
+    keys = ballot_keys(3)
+    sink = TraceSink(sample_rate=0.0)  # the caller's flag wins anyway
+    app, transport = make_traced_app([judge_script(keys[1])], sink)
+    caller_tid, caller_sid = "c" * 32, "d" * 16
+    model = make_model(
+        [{"model": "judge-a", "weight": {"type": "static", "weight": 1}}]
+    )
+
+    async def run(client):
+        resp = await client.post(
+            "/score/completions",
+            data=jsonutil.dumps(score_body(model)),
+            headers={
+                "content-type": "application/json",
+                "traceparent": format_traceparent(
+                    caller_tid, caller_sid, True
+                ),
+            },
+        )
+        assert resp.status == 200
+        assert resp.headers["x-trace-id"] == caller_tid
+        record = await (await client.get(f"/v1/traces/{caller_tid}")).json()
+        return record
+
+    record = go(with_client(app, run))
+    # our root hangs under the caller's span: one cross-service tree
+    assert record["trace_id"] == caller_tid
+    assert record["spans"][0]["parent_id"] == caller_sid
+    # and the caller's trace id rode our upstream judge call
+    tid, _, sampled = parse_traceparent(
+        transport.requests[0][1]["traceparent"]
+    )
+    assert tid == caller_tid and sampled
+
+
+def test_gateway_error_forced_despite_zero_sampling():
+    sink = TraceSink(sample_rate=0.0)
+
+    class Exploding:
+        async def create_unary(self, ctx, params):
+            raise RuntimeError("boom")
+
+        async def create_streaming(self, ctx, params):
+            raise RuntimeError("boom")
+
+    stub = Exploding()
+    app = build_app(stub, stub, stub, trace_sink=sink)
+
+    async def run(client):
+        resp = await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "q"}]},
+        )
+        assert resp.status == 500
+        body = await resp.json()
+        trace_id = resp.headers["x-trace-id"]
+        # the error envelope names the trace that explains it
+        assert body["trace_id"] == trace_id
+        record = await (await client.get(f"/v1/traces/{trace_id}")).json()
+        assert record["forced"] is True
+        assert record["status"] == "error"
+        # healthy unsampled traffic still drops
+        missing = await client.get("/v1/traces/" + "e" * 32)
+        assert missing.status == 404
+        assert (await missing.json())["code"] == 404
+
+    go(with_client(app, run))
+    assert sink.forced == 1

@@ -181,3 +181,34 @@ def test_gateway_400_body_carries_path():
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_specless_field_is_push_clone_only():
+    """Regression (satellite fix): a Struct field declared WITHOUT the
+    field() helper (no codec spec) used to poison the whole class's
+    encode/decode plans at first use — to_json_obj raised even when the
+    field was None.  Spec-less fields are push/clone-only state: encode
+    succeeds while they're None, decode ignores them, and the
+    declaration error fires only when a real value would need a codec."""
+    from llm_weighted_consensus_tpu.types.base import Struct, field
+
+    class Carrier(Struct):
+        name: str = field(str, default="")
+        scratch: object = None  # plain dataclass field: no codec spec
+
+    c = Carrier(name="a")
+    # encode works while the spec-less field is unset
+    assert c.to_json_obj() == {"name": "a"}
+    # decode ignores spec-less fields entirely (no wire contract)
+    d = Carrier.from_json_obj({"name": "b", "scratch": {"x": 1}})
+    assert d.name == "b" and d.scratch is None
+    # push/clone still carry the value
+    c.scratch = {"k": 1}
+    clone = c.clone()
+    assert clone.scratch == {"k": 1}
+    other = Carrier(name="z")
+    other.push(c)
+    assert other.scratch == {"k": 1}
+    # a real value cannot serialize: the declaration error fires at encode
+    with pytest.raises(TypeError, match=r"without the field\(\) helper"):
+        c.to_json_obj()

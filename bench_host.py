@@ -132,6 +132,60 @@ def build_engine(judges: int, n: int, requests: int, seed: int):
     return client, model_json
 
 
+def analysis_time_record() -> dict:
+    """--analysis-time: wall time of the full-package invariant checker
+    (the tier-1 analysis gate), budgeted at 30 s on CPU.  The AST lint
+    runs in-process (stdlib only); the jaxpr audit runs in a subprocess
+    so this process keeps its device-free / no-jax guarantee."""
+    import subprocess
+
+    from llm_weighted_consensus_tpu.analysis import (
+        apply_baseline,
+        load_baseline,
+        run_lint,
+    )
+
+    t0 = time.perf_counter()
+    kept, _suppressed, stale = apply_baseline(run_lint(), load_baseline())
+    lint_s = time.perf_counter() - t0
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys\n"
+            "from llm_weighted_consensus_tpu.analysis.jaxpr_audit import "
+            "run_jaxpr_audit\n"
+            "sys.exit(1 if run_jaxpr_audit() else 0)",
+        ],
+        env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    jaxpr_s = time.perf_counter() - t0
+
+    total_s = lint_s + jaxpr_s
+    return {
+        "metric": "full-package analysis wall time (AST lint + jaxpr audit)",
+        "value": round(total_s, 3),
+        "unit": "s",
+        "lint_seconds": round(lint_s, 3),
+        "jaxpr_seconds": round(jaxpr_s, 3),
+        "lint_findings": len(kept),
+        "stale_baseline": len(stale),
+        "jaxpr_clean": proc.returncode == 0,
+        "budget_seconds": 30,
+        "within_budget": total_s < 30,
+        "jax_imported": "jax" in sys.modules,
+        "note": (
+            "lint in-process (stdlib ast only), jaxpr audit in a "
+            "JAX_PLATFORMS=cpu subprocess so the host bench process "
+            "stays jax-free"
+        ),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--judges", type=int, default=8)
@@ -139,7 +193,20 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--requests", type=int, default=50)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--analysis-time",
+        action="store_true",
+        help="measure the tier-1 analysis gate instead of the host path",
+    )
     args = ap.parse_args()
+
+    if args.analysis_time:
+        record = analysis_time_record()
+        assert record["jax_imported"] is False, (
+            "host bench must stay device-free"
+        )
+        print(json.dumps(record), flush=True)
+        return
 
     from bench import BASELINE_BASIS, make_requests
     from llm_weighted_consensus_tpu.types.score_request import (

@@ -1,0 +1,53 @@
+"""First-party invariant checker: the conventions this codebase relies
+on, machine-enforced.
+
+Two engines, one CLI (``python -m llm_weighted_consensus_tpu.analysis``):
+
+* **AST lint** (``engine.py`` + ``rules/``) — walks the package source
+  and enforces the async-cancellation / resource-release / contextvar
+  -token / Decimal-purity / error-envelope invariants that earlier PRs
+  had to hand-audit (the PR 2 review alone closed six cancellation and
+  budget holes that these rules now catch mechanically).
+* **jaxpr audit** (``jaxpr_audit.py``) — lowers the embedder/consensus
+  serving functions for each AOT bucket on CPU and statically asserts
+  the compiled hot path's invariants: no host callbacks or transfers,
+  no int8->float dequant regressions in the fused W8A8 path, no f64
+  promotion leaks, and every serving bucket resolving to a precompiled
+  executable with zero stray jit specializations.
+
+Both report :class:`~.engine.Finding` objects; intentional deviations
+live in ``analysis/baseline.json`` with a written ``reason`` — the CLI
+fails on any non-baselined finding AND on stale baseline entries, so
+the suppression list can only shrink honestly.
+
+The lint engine imports nothing heavy (stdlib ``ast`` only); jax is
+imported only when the jaxpr audit actually runs.
+"""
+
+from __future__ import annotations
+
+from .engine import (  # noqa: F401
+    Finding,
+    ParsedModule,
+    apply_baseline,
+    baseline_entry,
+    default_baseline_path,
+    load_baseline,
+    package_root,
+    parse_module,
+    run_lint,
+    source_files,
+)
+
+__all__ = [
+    "Finding",
+    "ParsedModule",
+    "apply_baseline",
+    "baseline_entry",
+    "default_baseline_path",
+    "load_baseline",
+    "package_root",
+    "parse_module",
+    "run_lint",
+    "source_files",
+]

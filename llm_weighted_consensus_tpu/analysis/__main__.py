@@ -1,0 +1,142 @@
+"""CLI: ``python -m llm_weighted_consensus_tpu.analysis``.
+
+Runs the AST lint over the package, then the jaxpr audit (unless
+skipped), applies ``baseline.json``, and reports.
+
+Exit codes: **0** clean (every finding baselined or none), **1**
+non-baselined findings, **2** baseline problems (a stale suppression —
+the code it covered was fixed, so the entry must be deleted — or an
+entry missing its mandatory ``reason``).
+
+Flags/env: ``--no-jaxpr`` or ``ANALYSIS_SKIP_JAXPR=1`` skips the jaxpr
+audit (lint stays); ``--baseline PATH`` / ``ANALYSIS_BASELINE``
+overrides the baseline file; ``--rules LWC001,...`` restricts lint
+rules; ``--json`` emits machine-readable findings; positional paths
+lint specific files instead of the whole package.  The jaxpr audit's
+own knobs (``ANALYSIS_JAXPR_MODEL`` / ``_SPECS`` / ``_R_BUCKETS``) are
+documented in ``jaxpr_audit.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+from .engine import (
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    run_lint,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m llm_weighted_consensus_tpu.analysis",
+        description="first-party invariant checker (AST lint + jaxpr audit)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="lint only these files (default: the whole package)",
+    )
+    parser.add_argument(
+        "--no-jaxpr", action="store_true",
+        help="skip the jaxpr serving-path audit (ANALYSIS_SKIP_JAXPR=1)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help="suppression baseline (default analysis/baseline.json; "
+        "ANALYSIS_BASELINE overrides)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated lint rule subset, e.g. LWC001,LWC003",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    args = parser.parse_args(argv)
+
+    from .rules import ALL_RULES, RULES_BY_NAME
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.name}  {rule.summary}")
+        return 0
+
+    rules = None
+    if args.rules:
+        try:
+            rules = [RULES_BY_NAME[n.strip()] for n in args.rules.split(",")]
+        except KeyError as exc:
+            print(f"unknown rule {exc}", file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    findings = run_lint(paths=args.paths or None, rules=rules)
+    lint_s = time.perf_counter() - t0
+
+    jaxpr_s = 0.0
+    skip_jaxpr = args.no_jaxpr or bool(os.environ.get("ANALYSIS_SKIP_JAXPR"))
+    if not skip_jaxpr:
+        from .jaxpr_audit import run_jaxpr_audit
+
+        t0 = time.perf_counter()
+        findings += run_jaxpr_audit()
+        jaxpr_s = time.perf_counter() - t0
+
+    baseline_path = args.baseline or (
+        Path(os.environ["ANALYSIS_BASELINE"])
+        if os.environ.get("ANALYSIS_BASELINE")
+        else default_baseline_path()
+    )
+    try:
+        baseline = load_baseline(baseline_path)
+    except ValueError as exc:
+        print(f"baseline error: {exc}", file=sys.stderr)
+        return 2
+    kept, suppressed, stale = apply_baseline(findings, baseline)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [vars(f) for f in kept],
+                    "suppressed": [vars(f) for f in suppressed],
+                    "stale_baseline": stale,
+                    "lint_seconds": round(lint_s, 3),
+                    "jaxpr_seconds": round(jaxpr_s, 3),
+                }
+            )
+        )
+    else:
+        for finding in kept:
+            print(finding.render())
+        summary = (
+            f"analysis: {len(kept)} finding(s), {len(suppressed)} "
+            f"baselined, lint {lint_s:.2f}s"
+        )
+        if not skip_jaxpr:
+            summary += f", jaxpr audit {jaxpr_s:.2f}s"
+        print(summary, file=sys.stderr)
+
+    if stale:
+        for entry in stale:
+            print(
+                "stale baseline entry (the finding it suppressed is "
+                f"gone — delete it): {json.dumps(entry)}",
+                file=sys.stderr,
+            )
+        return 2
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

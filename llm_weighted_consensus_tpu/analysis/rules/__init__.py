@@ -1,0 +1,47 @@
+"""Rule registry for the AST lint engine.
+
+Each rule module exposes a single ``RULE`` object: a name (``LWCnnn``),
+a one-line summary, and a ``check(ParsedModule) -> list[Finding]``
+callable.  ``ALL_RULES`` is the ordered registry the engine and CLI
+iterate; adding a rule means adding a module here and one line below
+(see DESIGN.md "Static analysis" for the checklist).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Tuple
+
+from ..engine import Finding, ParsedModule
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    summary: str
+    check: Callable[[ParsedModule], List[Finding]]
+
+
+from . import (  # noqa: E402
+    lwc001_swallowed_cancellation,
+    lwc002_orphaned_task,
+    lwc003_release_in_finally,
+    lwc004_contextvar_token,
+    lwc005_decimal_purity,
+    lwc006_blocking_in_async,
+    lwc007_envelope_kind,
+)
+
+ALL_RULES: Tuple[Rule, ...] = (
+    lwc001_swallowed_cancellation.RULE,
+    lwc002_orphaned_task.RULE,
+    lwc003_release_in_finally.RULE,
+    lwc004_contextvar_token.RULE,
+    lwc005_decimal_purity.RULE,
+    lwc006_blocking_in_async.RULE,
+    lwc007_envelope_kind.RULE,
+)
+
+RULES_BY_NAME = {rule.name: rule for rule in ALL_RULES}
+
+__all__ = ["Rule", "ALL_RULES", "RULES_BY_NAME"]

@@ -421,15 +421,25 @@ class DeviceBatcher:
                     # bounded pipelining: block here (arrivals keep
                     # appending to _pending) until a dispatch slot frees
                     await self._sem.acquire()
-                    # shed AFTER the slot wait — that queueing delay is
-                    # exactly where deadlines die under overload
-                    group = self._shed_group(group)
-                    if not group:
-                        self._sem.release()
-                        continue
-                    task = loop.create_task(self._run_group(loop, group))
-                    inflight.add(task)
-                    task.add_done_callback(inflight.discard)
+                    # the slot is owned here until _run_group takes it:
+                    # release on every non-handoff exit (shed-to-empty,
+                    # _shed_group raising) or the pipeline wedges one
+                    # depth shallower per leak
+                    handed_off = False
+                    try:
+                        # shed AFTER the slot wait — that queueing delay
+                        # is exactly where deadlines die under overload
+                        group = self._shed_group(group)
+                        if group:
+                            task = loop.create_task(
+                                self._run_group(loop, group)
+                            )
+                            inflight.add(task)
+                            task.add_done_callback(inflight.discard)
+                            handed_off = True
+                    finally:
+                        if not handed_off:
+                            self._sem.release()
             else:
                 # park until a dispatch finishes OR a new item arrives
                 # (_submit sets the wake event) — a free pipeline slot

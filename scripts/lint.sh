@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Lint entry point: generic lint (ruff, if installed — config pinned in
+# pyproject.toml) + the first-party invariant checker (AST rules +
+# jaxpr serving-path audit).  Run from anywhere; extra args pass
+# through to the checker (e.g. scripts/lint.sh --no-jaxpr file.py).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+if command -v ruff >/dev/null 2>&1; then
+  ruff check llm_weighted_consensus_tpu tests bench.py bench_host.py || rc=$?
+else
+  echo "lint.sh: ruff not installed; skipping generic lint" \
+       "(first-party invariant checker still runs)" >&2
+fi
+
+env JAX_PLATFORMS=cpu python -m llm_weighted_consensus_tpu.analysis "$@" \
+  || rc=$?
+exit $rc

@@ -5,4 +5,8 @@
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); \
 # obs/ tracing tests, explicitly: the glob above already collects them, but
 # this names the file so a collection error there can never pass silently.
-timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_obs=$?; [ $rc -eq 0 ] && rc=$rc_obs; exit $rc
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_obs=$?; [ $rc -eq 0 ] && rc=$rc_obs; \
+# analysis gate, explicitly: tests/test_analysis.py runs the same checker
+# under pytest, but naming the CLI here means a lint finding or a jaxpr
+# serving-path regression fails tier-1 even if test collection breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m llm_weighted_consensus_tpu.analysis; rc_an=$?; [ $rc -eq 0 ] && rc=$rc_an; exit $rc

@@ -1,0 +1,25 @@
+"""LWC001 violating fixture: three handler shapes that swallow
+cancellation in an async function."""
+
+import asyncio
+
+
+async def fetch(client):
+    try:
+        return await client.get()
+    except:  # noqa: E722 — bare except swallows CancelledError
+        return None
+
+
+async def fetch_base(client):
+    try:
+        return await client.get()
+    except BaseException:
+        return None
+
+
+async def fetch_cancel(client):
+    try:
+        return await client.get()
+    except asyncio.CancelledError:
+        return None

@@ -1,0 +1,28 @@
+"""LWC001 conforming fixture: Exception is cancellation-transparent,
+BaseException with a re-raise is a cleanup bracket, and a canceller may
+reap its own CancelledError."""
+
+import asyncio
+
+
+async def fetch(client):
+    try:
+        return await client.get()
+    except Exception:  # CancelledError derives from BaseException: passes
+        return None
+
+
+async def fetch_cleanup(client, stream):
+    try:
+        return await client.get()
+    except BaseException:
+        stream.close()
+        raise
+
+
+async def reap(task):
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass  # our own cancellation coming back

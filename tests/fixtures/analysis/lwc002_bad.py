@@ -1,0 +1,7 @@
+"""LWC002 violating fixture: the task handle is dropped on the floor."""
+
+import asyncio
+
+
+async def spawn(coro):
+    asyncio.create_task(coro)

@@ -1,0 +1,17 @@
+"""LWC002 conforming fixture: every spawned handle is retained (bound,
+appended, or structurally owned by a TaskGroup)."""
+
+import asyncio
+
+
+async def spawn(coro, other, tasks):
+    task = asyncio.create_task(coro)
+    tasks.append(asyncio.create_task(other))
+    try:
+        await asyncio.gather(*tasks)
+    finally:
+        task.cancel()
+
+
+async def grouped(tg, coro):
+    tg.create_task(coro)  # the TaskGroup owns the handle

@@ -1,0 +1,9 @@
+"""LWC003 violating fixture: the release exists but is skipped when the
+awaited work raises or is cancelled."""
+
+
+async def run(sem, work):
+    await sem.acquire()
+    result = await work()
+    sem.release()
+    return result

@@ -1,0 +1,16 @@
+"""LWC003 conforming fixture: release in finally; and a claim whose
+ownership is handed to another scope (no local release at all) is not
+this rule's business."""
+
+
+async def run(sem, work):
+    await sem.acquire()
+    try:
+        return await work()
+    finally:
+        sem.release()
+
+
+async def handoff(sem, dispatch):
+    await sem.acquire()
+    dispatch(sem)  # the dispatched task releases; ownership moved

@@ -1,0 +1,18 @@
+"""LWC004 violating fixture: context tokens with no reset/deactivate in
+a finally — a cancellation mid-await leaks the ambient state."""
+
+import contextvars
+
+_STATE = contextvars.ContextVar("state")
+
+
+async def handle(request, process):
+    token = _STATE.set(request)
+    result = await process(request)
+    _STATE.reset(token)  # unreachable if process() raises or is cancelled
+    return result
+
+
+async def handle_deadline(deadline, request, process):
+    tok = deadline.activate()
+    return await process(request, tok)

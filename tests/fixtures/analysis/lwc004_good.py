@@ -1,0 +1,28 @@
+"""LWC004 conforming fixture: reset in finally; the __enter__/__exit__
+cross-method bracket; and the activate() idiom that returns the token
+to the caller."""
+
+import contextvars
+
+_STATE = contextvars.ContextVar("state")
+
+
+async def handle(request, process):
+    token = _STATE.set(request)
+    try:
+        return await process(request)
+    finally:
+        _STATE.reset(token)
+
+
+class Scope:
+    def __enter__(self):
+        self._token = _STATE.set(self)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.reset(self._token)
+
+
+def activate(value):
+    return _STATE.set(value)  # ownership (and the reset duty) moves out

@@ -1,0 +1,12 @@
+"""LWC005 violating fixture: float literals contaminating the exact
+Decimal tally."""
+
+from decimal import Decimal
+
+
+def tally(votes):
+    total = Decimal("0")
+    for v in votes:
+        total = total + 0.5
+    total += 0.25
+    return total, Decimal(0.1)

@@ -1,0 +1,16 @@
+"""LWC005 conforming fixture: Decimal-pure tally math; float only as an
+explicit export at the explain/metrics edge."""
+
+from decimal import Decimal
+
+
+def tally(votes):
+    total = Decimal("0")
+    half = Decimal("0.5")
+    for v in votes:
+        total += v * half
+    return total
+
+
+def explain(weight):
+    return float(weight)

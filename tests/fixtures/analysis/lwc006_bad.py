@@ -1,0 +1,14 @@
+"""LWC006 violating fixture: synchronous sleep and file IO on the event
+loop."""
+
+import time
+
+
+async def wait_for_ready(check):
+    while not check():
+        time.sleep(0.05)
+
+
+async def load(path):
+    with open(path) as f:
+        return f.read()

@@ -1,0 +1,17 @@
+"""LWC006 conforming fixture: asyncio.sleep, and blocking IO shipped to
+the executor (the nested def runs off-loop, so it is exempt)."""
+
+import asyncio
+
+
+async def wait_for_ready(check):
+    while not check():
+        await asyncio.sleep(0.05)
+
+
+async def load(loop, path):
+    def _read():
+        with open(path) as f:
+            return f.read()
+
+    return await loop.run_in_executor(None, _read)

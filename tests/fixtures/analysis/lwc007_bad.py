@@ -1,0 +1,11 @@
+"""LWC007 violating fixture: dict-shaped error payloads without the
+`kind` discriminator."""
+
+
+class QuotaError:
+    def message(self):
+        return {"retry_after": 5}
+
+
+def envelope(detail):
+    return {"code": 429, "message": {"detail": detail}}

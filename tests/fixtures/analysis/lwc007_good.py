@@ -1,0 +1,11 @@
+"""LWC007 conforming fixture: every dict-shaped error payload carries
+its `kind`."""
+
+
+class QuotaError:
+    def message(self):
+        return {"kind": "quota", "retry_after": 5}
+
+
+def envelope(detail):
+    return {"code": 429, "message": {"kind": "quota", "detail": detail}}

@@ -1,0 +1,278 @@
+"""The analysis gate: first-party lint rules + the jaxpr serving-path
+audit (ISSUE 6).
+
+Three layers:
+
+* per-rule fixture tests — every rule fires on its violating fixture
+  (exact count: the fixtures enumerate the shapes the rule knows) and
+  stays silent on the conforming one; all rules together stay silent on
+  every conforming fixture (no cross-rule false positives);
+* the baseline machinery — suppression round-trip, mandatory reasons,
+  stale-entry detection;
+* THE gate — the whole package lints clean against the committed
+  baseline, and the jaxpr audit of the int8 serving path (structure +
+  AOT coverage + specialization guard) returns zero findings on CPU;
+  plus injected-regression tests proving the auditor actually catches
+  host transfers / dequant upcasts and names the offending primitive.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from llm_weighted_consensus_tpu.analysis import (
+    apply_baseline,
+    baseline_entry,
+    default_baseline_path,
+    load_baseline,
+    run_lint,
+)
+from llm_weighted_consensus_tpu.analysis.rules import ALL_RULES, RULES_BY_NAME
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+
+# rule -> number of violations its bad fixture enumerates
+EXPECTED_BAD = {
+    "LWC001": 3,  # bare / BaseException / CancelledError-no-reraise
+    "LWC002": 1,
+    "LWC003": 1,
+    "LWC004": 2,  # ContextVar.set + .activate() tokens
+    "LWC005": 3,  # BinOp + AugAssign + Decimal(float)
+    "LWC006": 2,  # time.sleep + open
+    "LWC007": 2,  # message() + wire envelope
+}
+
+
+def lint_fixture(name: str, rule: str = None):
+    rules = [RULES_BY_NAME[rule]] if rule else None
+    return run_lint(paths=[FIXTURES / name], rules=rules)
+
+
+# -- per-rule fixtures -------------------------------------------------------
+
+
+def test_registry_covers_expected_rules():
+    assert sorted(r.name for r in ALL_RULES) == sorted(EXPECTED_BAD)
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_BAD))
+def test_rule_fires_on_bad_fixture(rule):
+    findings = lint_fixture(f"{rule.lower()}_bad.py", rule)
+    assert len(findings) == EXPECTED_BAD[rule], [
+        f.render() for f in findings
+    ]
+    assert all(f.rule == rule for f in findings)
+    # findings carry the symbol (the baseline matching key)
+    assert all(f.symbol for f in findings)
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_BAD))
+def test_rule_silent_on_good_fixture(rule):
+    assert lint_fixture(f"{rule.lower()}_good.py", rule) == []
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_BAD))
+def test_good_fixtures_clean_under_all_rules(rule):
+    """A conforming fixture must not trip ANY rule — the conforming
+    idioms are exactly the repo's own, so a cross-rule false positive
+    here means the gate would fight real code."""
+    findings = lint_fixture(f"{rule.lower()}_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+# -- baseline machinery ------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = run_lint(paths=[FIXTURES / "lwc001_bad.py"])
+    assert findings
+    entries = [baseline_entry(f, "fixture: intentionally bad") for f in findings]
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"suppressions": entries}))
+
+    kept, suppressed, stale = apply_baseline(findings, load_baseline(path))
+    assert kept == []
+    assert len(suppressed) == len(findings)
+    assert stale == []
+
+    # "the code got fixed": the same baseline against a clean file makes
+    # every entry stale — the CLI fails until they're deleted
+    clean = run_lint(paths=[FIXTURES / "lwc001_good.py"])
+    kept2, _, stale2 = apply_baseline(clean, load_baseline(path))
+    assert kept2 == clean
+    assert len(stale2) == len(entries)
+
+
+def test_baseline_reason_is_mandatory(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(
+        json.dumps(
+            {"suppressions": [{"rule": "LWC001", "path": "x.py", "symbol": None}]}
+        )
+    )
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(path)
+
+
+def test_committed_baseline_is_small_and_reasoned():
+    entries = load_baseline(default_baseline_path())
+    assert len(entries) <= 10
+    assert all(str(e["reason"]).strip() for e in entries)
+
+
+# -- THE gate: the package itself --------------------------------------------
+
+
+def test_package_lints_clean_against_baseline():
+    kept, _suppressed, stale = apply_baseline(run_lint(), load_baseline())
+    assert stale == [], stale
+    assert kept == [], "\n".join(f.render() for f in kept)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    from llm_weighted_consensus_tpu.analysis.__main__ import main
+
+    assert main([str(FIXTURES / "lwc002_good.py"), "--no-jaxpr"]) == 0
+    rc = main([str(FIXTURES / "lwc002_bad.py"), "--no-jaxpr"])
+    assert rc == 1
+    assert "LWC002" in capsys.readouterr().out
+
+    stale = tmp_path / "stale.json"
+    stale.write_text(
+        json.dumps(
+            {
+                "suppressions": [
+                    {
+                        "rule": "LWC001",
+                        "path": "gone.py",
+                        "symbol": None,
+                        "reason": "covered code was deleted",
+                    }
+                ]
+            }
+        )
+    )
+    assert (
+        main(
+            [
+                str(FIXTURES / "lwc002_good.py"),
+                "--no-jaxpr",
+                "--baseline",
+                str(stale),
+            ]
+        )
+        == 2
+    )
+
+
+def test_cli_module_entry_point():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "llm_weighted_consensus_tpu.analysis",
+            "--no-jaxpr",
+            "--json",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=Path(__file__).parent.parent,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+
+
+# -- jaxpr audit -------------------------------------------------------------
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from llm_weighted_consensus_tpu.analysis.jaxpr_audit import (  # noqa: E402
+    audit_traced,
+    run_jaxpr_audit,
+)
+
+SDS = jax.ShapeDtypeStruct
+
+
+def test_jaxpr_audit_serving_path_clean():
+    """The acceptance: the int8 serving path (every AOT bucket,
+    structure + coverage + specialization guard) audits clean on CPU."""
+    findings = run_jaxpr_audit()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_audit_clean_toy_fn_passes():
+    w = jnp.ones((4, 4), jnp.float32)
+    assert (
+        audit_traced(lambda x: jnp.dot(x, w), (SDS((4, 4), jnp.float32),), "ok")
+        == []
+    )
+
+
+def test_audit_names_injected_device_put():
+    w = jnp.ones((4, 4), jnp.float32)
+    findings = audit_traced(
+        lambda x: jnp.dot(jax.device_put(x), w),
+        (SDS((4, 4), jnp.float32),),
+        "toy",
+    )
+    assert [f.rule for f in findings] == ["JXA001"]
+    assert "device_put" in findings[0].message
+
+
+def test_audit_names_injected_callback():
+    findings = audit_traced(
+        lambda x: jax.pure_callback(
+            lambda v: np.asarray(v), SDS((4,), jnp.float32), x
+        ),
+        (SDS((4,), jnp.float32),),
+        "toy",
+    )
+    assert [f.rule for f in findings] == ["JXA001"]
+    assert "pure_callback" in findings[0].message
+
+
+def test_audit_catches_trace_time_device_get():
+    """jax.device_get/np.asarray on a tracer never reaches the jaxpr —
+    the auditor reports the trace-time concretization as JXA001."""
+    findings = audit_traced(
+        lambda x: jnp.asarray(np.asarray(x)) + 1.0,
+        (SDS((4,), jnp.float32),),
+        "toy",
+    )
+    assert [f.rule for f in findings] == ["JXA001"]
+    assert "trace time" in findings[0].message
+
+
+def test_audit_names_injected_int8_upcast():
+    w = jnp.ones((4, 4), jnp.float32)
+    findings = audit_traced(
+        lambda q: jnp.dot(q.astype(jnp.float32), w),
+        (SDS((4, 4), jnp.int8),),
+        "toy",
+    )
+    assert [f.rule for f in findings] == ["JXA002"]
+    assert "convert_element_type" in findings[0].message
+
+
+def test_audit_flags_missing_pallas_kernel():
+    """expect_pallas asserts the fused kernel is still in the forward."""
+    w = jnp.ones((4, 4), jnp.float32)
+    findings = audit_traced(
+        lambda x: jnp.dot(x, w),
+        (SDS((4, 4), jnp.float32),),
+        "toy",
+        expect_pallas=True,
+    )
+    assert [f.rule for f in findings] == ["JXA002"]
+    assert "pallas" in findings[0].message

@@ -212,23 +212,37 @@ def measure_device_only_ms(
 
 
 def probe_backend(timeout_s: float) -> dict:
-    """Initialize the JAX backend in a THROWAWAY subprocess with a hard
-    timeout, and report what it found.
+    """Initialize the JAX backend AND run one tiny real device
+    computation in a THROWAWAY subprocess with a hard timeout, and
+    report what it found.
 
     On this image a wedged TPU tunnel makes backend init *hang* (not
     raise) — r4's driver bench died without emitting a parseable record
     (VERDICT r4 weak-3).  The parent must therefore never be the first
     process to touch the backend: this probe bounds the risk to
     ``timeout_s`` and lets the caller emit a structured degraded record
-    instead of a traceback.  LWC_BENCH_PROBE_CODE overrides the probe body
-    (used by tests to simulate a wedge).
+    instead of a traceback.
+
+    The probe body dispatches a tiny dot product and blocks on the
+    result (not just backend init): BENCH_r04/r05 showed a tunnel that
+    initializes cleanly and then wedges on the FIRST dispatch, which a
+    init-only probe waves through — the old 240 s default then had the
+    600 s body watchdog as the only backstop, a ~14-minute hang per
+    bench before a degraded record appeared.  With the dispatch in the
+    probe, a healthy backend answers in single-digit seconds and the
+    default timeout drops to seconds scale (--probe-timeout 45), so a
+    wedged tunnel records ``tpu-unavailable`` in seconds.
+    LWC_BENCH_PROBE_CODE overrides the probe body (used by tests to
+    simulate a wedge).
     """
     import os
     import subprocess
 
     code = os.environ.get(
         "LWC_BENCH_PROBE_CODE",
-        "import jax\n"
+        "import jax, jax.numpy as jnp\n"
+        "x = jnp.arange(64, dtype=jnp.float32)\n"
+        "jnp.dot(x, x).block_until_ready()\n"
         "print('BACKEND=' + jax.default_backend(), 'NDEV=%d' % len(jax.devices()))\n",
     )
     try:
@@ -390,15 +404,16 @@ def main() -> int:
     parser.add_argument(
         "--probe-timeout",
         type=float,
-        default=240.0,
-        help="hard bound (s) on the throwaway backend-init probe; on "
-        "expiry one degraded JSON record is emitted instead of hanging. "
-        "Historically this bounded ONLY the probe: a PJRT call that "
-        "wedged AFTER a clean probe (first real dispatch, mid-bench) "
-        "could still hang the round forever.  The bench body now runs "
-        "under its own watchdog (probe-timeout + 600 s, covering worst-"
-        "case cold compiles) that emits the degraded record and exits 2 "
-        "on expiry, closing that residual window",
+        default=45.0,
+        help="hard bound (s) on the throwaway pre-flight probe (backend "
+        "init + one tiny device dispatch); on expiry one degraded JSON "
+        "record is emitted in seconds instead of hanging.  Historically "
+        "the probe covered init ONLY and defaulted to 240 s: a tunnel "
+        "that wedged on the first real dispatch slid past it into the "
+        "body watchdog, ~14 minutes before any record (BENCH_r04/r05). "
+        "The bench body still runs under its own watchdog (probe-timeout "
+        "+ 600 s, covering worst-case cold compiles) that emits the "
+        "degraded record and exits 2 on expiry, for mid-bench wedges",
     )
     parser.add_argument(
         "--quantize",

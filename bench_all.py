@@ -585,9 +585,10 @@ def main() -> int:
     parser.add_argument(
         "--probe-timeout",
         type=float,
-        default=240.0,
-        help="hard bound (s) on the throwaway backend-init probe "
-        "(bench.py wedge-proofing)",
+        default=45.0,
+        help="hard bound (s) on the throwaway pre-flight probe — backend "
+        "init + one tiny device dispatch (bench.py wedge-proofing; a "
+        "wedged tunnel records tpu-unavailable in seconds)",
     )
     args = parser.parse_args()
     q = args.quick

@@ -53,6 +53,15 @@ admitted p99 within ~2x unloaded while the excess sheds retryably.
 services — tracing off, ``TRACE_SAMPLE_RATE=0.01``, and ``1.0`` —
 reporting the p50 inflation of each traced setting over off.  The
 acceptance bar is <= 2%% at 1%% sampling.
+
+``--mixed-lengths`` replaces the trio with the continuous-batching
+scenario (serve/packing.py): the SAME open-loop mixed-length
+/consensus arrival process (short-head/long-tail lengths, mixed
+candidate counts, shared conversation prefixes) driven at 1.5x the
+padded service's closed-loop capacity against a bucketed-padded and a
+packed (``PACKING_ENABLED=1``) service, reporting goodput for each
+plus the served packing-efficiency counters (real tokens vs dispatched
+slot tokens, prefix-dedup hits).
 """
 
 from __future__ import annotations
@@ -778,11 +787,196 @@ async def bench_trace_overhead(args) -> None:
     )
 
 
+async def bench_mixed_lengths(args) -> None:
+    """Continuous-batching goodput (ISSUE PR 7): the SAME open-loop
+    mixed-length /consensus arrival process against two fresh services —
+    the bucketed-padded path and the packed path (``PACKING_ENABLED=1``,
+    serve/packing.py) — reporting goodput for each plus the served
+    /metrics packing-efficiency counters.
+
+    The workload is where padding hurts: request lengths drawn from a
+    short-head/long-tail mixture (60% chat-short, 30% paragraph, 10%
+    document) and candidate counts mixed per request, so the padded
+    dispatch pads every row to the group seq bucket AND buckets each
+    distinct (N, temperature) into its own group, while the packed path
+    lays all of it end-to-end in shared rows.  Arrivals are open-loop at
+    1.5x the PADDED service's measured closed-loop capacity — offered
+    load the padded path cannot clear, so
+    goodput separates the paths instead of both idling at the arrival
+    rate.  Success (200 within deadline) counts toward goodput; the
+    padding-waste ratios (real tokens / dispatched slot tokens) come
+    from each service's own counters."""
+    import aiohttp
+
+    rng = np.random.default_rng(11)
+
+    def text(words: int, tag: str) -> str:
+        return f"{tag} " + " ".join(
+            rng.choice(BENCH_WORDS, size=max(1, words)).tolist()
+        )
+
+    def request_texts(i: int) -> list:
+        n = int(rng.choice([3, 4, 6, 8], p=[0.3, 0.3, 0.25, 0.15]))
+        kind = rng.random()
+        if kind < 0.6:
+            words = int(rng.integers(4, 17))
+        elif kind < 0.9:
+            words = int(rng.integers(24, 65))
+        else:
+            words = int(rng.integers(96, 193))
+        # shared conversation prefix + divergent answers: the realistic
+        # consensus shape, and what PREFIX_DEDUP exists for
+        prefix = text(words, f"ctx {i}")
+        return [f"{prefix} answer {j} {text(6, 'a')}" for j in range(n)]
+
+    bodies = [
+        json.dumps({"input": request_texts(i), "temperature": 0.05})
+        for i in range(args.requests)
+    ]
+
+    settings = [
+        ("padded", {"PACKING_ENABLED": "0"}),
+        ("packed", {"PACKING_ENABLED": "1"}),
+    ]
+    results = {}
+    padded_capacity = None
+    for label, env in settings:
+        runner, fake_runner, port, _ = await _start_service(
+            args.model, args.window_ms, args.quantize, extra_env=env
+        )
+        url = f"http://127.0.0.1:{port}/consensus"
+        try:
+            async with aiohttp.ClientSession(
+                headers={"content-type": "application/json"}
+            ) as session:
+                # closed-loop capacity first (also the jit/AOT warmup);
+                # the PADDED run's capacity sets the open-loop rate for
+                # BOTH services, so they face identical offered load
+                total, lat = await _drive(
+                    session, url, bodies, args.concurrency
+                )
+                capacity = len(bodies) / total
+                if padded_capacity is None:
+                    padded_capacity = capacity
+                offered = padded_capacity * 1.5
+
+                ok_lat: list = []
+                failures = 0
+
+                async def one(b):
+                    nonlocal failures
+                    t0 = time.perf_counter()
+                    try:
+                        async with session.post(url, data=b) as resp:
+                            await resp.read()
+                            if resp.status == 200:
+                                ok_lat.append(
+                                    (time.perf_counter() - t0) * 1e3
+                                )
+                            else:
+                                failures += 1
+                    except Exception:
+                        failures += 1
+
+                interval = 1.0 / offered
+                t_start = time.perf_counter()
+                tasks = []
+                for i, b in enumerate(bodies):
+                    delay = (
+                        t_start + i * interval - time.perf_counter()
+                    )
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    tasks.append(asyncio.ensure_future(one(b)))
+                await asyncio.gather(*tasks)
+                open_total = time.perf_counter() - t_start
+
+                async def batcher_stats():
+                    async with session.get(
+                        f"http://127.0.0.1:{port}/metrics"
+                    ) as resp:
+                        return (await resp.json()).get(
+                            "device_batcher", {}
+                        )
+
+                stats = await batcher_stats()
+
+                # saturated burst — every request in flight at once, so
+                # dispatch groups (and packed calls) reach their full
+                # size: the real-token/slot-token ratio HERE is the
+                # packing-efficiency acceptance number (the open-loop
+                # phase above under-fills calls by design: arrivals
+                # trickle in at the padded path's pace)
+                before = stats
+                await _drive(
+                    session, url, bodies, len(bodies), warmup_bursts=0
+                )
+                after = await batcher_stats()
+                sat_key = "packing" if env["PACKING_ENABLED"] == "1" else "padded"
+                d_real = (after[sat_key]["real_tokens"]
+                          - before[sat_key]["real_tokens"])
+                d_slot = (after[sat_key]["slot_tokens"]
+                          - before[sat_key]["slot_tokens"])
+            results[label] = {
+                "goodput_rps": round(len(ok_lat) / open_total, 3),
+                "closed_loop_rps": round(capacity, 3),
+                "offered_rps": round(offered, 3),
+                "failures": failures,
+                **_percentiles(ok_lat or [0.0]),
+                "saturated_efficiency": (
+                    round(d_real / d_slot, 4) if d_slot else None
+                ),
+                "saturated_real_tokens": d_real,
+                "saturated_slot_tokens": d_slot,
+                "packing": after.get("packing"),
+                "padded": after.get("padded"),
+            }
+        finally:
+            await runner.cleanup()
+            await fake_runner.cleanup()
+
+    padded_good = results["padded"]["goodput_rps"]
+    packed_good = results["packed"]["goodput_rps"]
+    emit(
+        "/consensus?mixed-lengths",
+        packed_good,
+        "goodput requests/sec",
+        requests=args.requests,
+        concurrency=args.concurrency,
+        goodput_ratio=(
+            round(packed_good / padded_good, 3) if padded_good else None
+        ),
+        closed_loop_ratio=(
+            round(
+                results["packed"]["closed_loop_rps"]
+                / results["padded"]["closed_loop_rps"],
+                3,
+            )
+            if results["padded"]["closed_loop_rps"]
+            else None
+        ),
+        **results,
+        note=(
+            "open-loop mixed-length /consensus arrivals at 1.5x the "
+            "PADDED service's closed-loop capacity, against "
+            "bucketed-padded vs packed (PACKING_ENABLED=1) services; "
+            "goodput = 200 completions/sec; saturated_efficiency = "
+            "real-tokens/dispatched-slots measured from the served "
+            "counters over an all-in-flight burst (full dispatch "
+            "groups — the packing-efficiency acceptance number); "
+            "'packing'/'padded' = each service's cumulative counters"
+        ),
+    )
+
+
 async def main_async(args) -> None:
     import aiohttp
 
     if args.trace_overhead:
         await bench_trace_overhead(args)
+        return
+    if args.mixed_lengths:
+        await bench_mixed_lengths(args)
         return
     overload_env = None
     if args.overload:
@@ -901,6 +1095,15 @@ def main() -> None:
         "fresh services (tracing off / TRACE_SAMPLE_RATE=0.01 / 1.0); "
         "reports p50 inflation per setting vs off",
     )
+    parser.add_argument(
+        "--mixed-lengths",
+        action="store_true",
+        help="run the continuous-batching scenario instead of the "
+        "endpoint trio: the same open-loop mixed-length /consensus "
+        "arrival process against a bucketed-padded and a packed "
+        "(PACKING_ENABLED=1) service; reports goodput for each plus "
+        "the served packing-efficiency counters",
+    )
     parser.add_argument("--n", type=int, default=64)
     parser.add_argument("--requests", type=int, default=100)
     parser.add_argument("--concurrency", type=int, default=16)
@@ -911,10 +1114,11 @@ def main() -> None:
     parser.add_argument(
         "--probe-timeout",
         type=float,
-        default=240.0,
-        help="hard bound (s) on the throwaway backend-init probe "
-        "(bench.py wedge-proofing); on expiry a degraded JSON record is "
-        "emitted instead of hanging",
+        default=45.0,
+        help="hard bound (s) on the throwaway pre-flight probe — backend "
+        "init + one tiny device dispatch (bench.py wedge-proofing); on "
+        "expiry a degraded JSON record is emitted in seconds instead of "
+        "hanging",
     )
     args = parser.parse_args()
     if args.quick:

@@ -258,7 +258,9 @@ class TpuEmbedder:
             return None
         return self._aot.get(key)
 
-    def aot_warmup(self, specs: list, r_buckets: list = ()) -> list:
+    def aot_warmup(
+        self, specs: list, r_buckets: list = (), packed_buckets: list = ()
+    ) -> list:
         """AOT-lower-and-compile (``.lower().compile()``) every serving
         bucket up front: for each (N, S) spec the single-request consensus
         dispatch (both vote variants — the fused-kernel default and the
@@ -328,6 +330,24 @@ class TpuEmbedder:
                 timings.append((
                     f"grouped R={r} {n}x{s}", _time.perf_counter() - t0
                 ))
+        # packed-capacity buckets (continuous batching, serve/packing.py):
+        # (rows, row_tokens, max_segments) triples — the small fixed set
+        # replacing the (R, N, S) lattice on the packed dispatch path
+        for b_rows, l_tokens, k_segs in packed_buckets:
+            key = ("packed", b_rows, l_tokens, k_segs)
+            if key in self._aot:
+                continue
+            row_av = sds((b_rows, l_tokens), jnp.int32)
+            starts_av = sds((b_rows, k_segs), jnp.int32)
+            t0 = _time.perf_counter()
+            self._aot[key] = bert.embed_packed.lower(
+                self.params, row_av, row_av, row_av, starts_av,
+                self.config, pooling=self.pooling, normalize=True,
+            ).compile()
+            timings.append((
+                f"packed {b_rows}x{l_tokens}/k{k_segs}",
+                _time.perf_counter() - t0,
+            ))
         return timings
 
     def jit_stats(self) -> dict:
@@ -344,6 +364,7 @@ class TpuEmbedder:
                 "stream_vote_update_many": (
                     _stream_vote_update_many._cache_size()
                 ),
+                "embed_packed": bert.embed_packed._cache_size(),
             },
         }
 
@@ -399,6 +420,68 @@ class TpuEmbedder:
             normalize=True,
         )
         return np.asarray(emb[:b])
+
+    # -- packed (continuous-batching) path ------------------------------------
+
+    def supports_packing(self) -> bool:
+        """Whether the ragged packed dispatch is usable.  Same gate as
+        the AOT fast path: the packed entry bypasses ``put_batch`` /
+        ``embed_override`` (its layout is not the [B, S] the mesh hooks
+        were built for), so mesh-sharded embedders keep the padded
+        paths."""
+        return self._aot_ready()
+
+    def tokenize_ragged(
+        self, texts: Iterable[str], max_tokens: Optional[int] = None
+    ) -> list:
+        """texts -> list of 1-D int32 token rows, padding stripped.  The
+        packing planner consumes these as segments; each row is exactly
+        what ``tokenize`` would produce for that text before padding, so
+        a packed segment embeds the same token stream as its padded
+        twin."""
+        cap = min(max_tokens or self.max_tokens, self.max_tokens)
+        ids, mask = self.tokenizer.encode_batch(list(texts), cap)
+        lens = mask.sum(axis=1)
+        return [ids[i, : int(lens[i])] for i in range(ids.shape[0])]
+
+    def embed_packed(
+        self,
+        ids: np.ndarray,
+        segment_ids: np.ndarray,
+        positions: np.ndarray,
+        seg_starts: np.ndarray,
+    ) -> np.ndarray:
+        """Packed layout [B, L] (+ seg_starts[B, K]) -> per-segment-slot
+        embeddings [B, K, H] (f32, l2-normalized).  One device dispatch;
+        consults the AOT table at the ("packed", B, L, K) bucket first so
+        warmed packed traffic creates zero jit specializations."""
+        b, l = ids.shape
+        k = seg_starts.shape[1]
+        exe = self._aot_lookup(("packed", b, l, k), ids, segment_ids)
+        if exe is not None and (
+            positions.dtype == np.int32 and seg_starts.dtype == np.int32
+        ):
+            return np.asarray(
+                exe(
+                    self.params,
+                    jnp.asarray(ids),
+                    jnp.asarray(segment_ids),
+                    jnp.asarray(positions),
+                    jnp.asarray(seg_starts),
+                )
+            )
+        return np.asarray(
+            bert.embed_packed(
+                self.params,
+                jnp.asarray(ids),
+                jnp.asarray(segment_ids),
+                jnp.asarray(positions),
+                jnp.asarray(seg_starts),
+                self.config,
+                pooling=self.pooling,
+                normalize=True,
+            )
+        )
 
     def consensus_confidence(
         self,

@@ -144,6 +144,95 @@ def fused_attention_tiled(
     return out.reshape(b, nh, s, hd).transpose(0, 2, 1, 3)
 
 
+def _attn_kernel_tiled_seg(
+    q_ref, k_ref, v_ref, seg_ref, out_ref, *, scale: float
+):
+    # Segment-masked variant for the packed (continuous-batching) layout:
+    # instead of a per-key additive padding bias, the block carries the
+    # int32 segment-ids row [k, 1, s] and the mask is computed IN VMEM —
+    # query i attends key j iff seg[i] == seg[j] and seg[j] > 0 (0 marks
+    # pad slots).  Building the [s, s] mask here costs one compare per
+    # logit and keeps the HBM traffic identical to the padded kernel
+    # (a pre-materialized [b*nh, s, s] bias would triple it at s=512).
+    q = q_ref[:]  # [k, s, hd]
+    k = k_ref[:]
+    v = v_ref[:]
+    logits = (
+        jax.lax.dot_general(
+            q,
+            k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [k, s, s] f32
+    seg = seg_ref[:, 0, :]  # [k, s] int32
+    same = (seg[:, :, None] == seg[:, None, :]) & (seg[:, None, :] > 0)
+    logits = jnp.where(same, logits, -1e9)
+    # pad-slot query rows are fully masked: every logit is the same -1e9,
+    # so the softmax is uniform (never 0/0) and the garbage rows are
+    # dropped by segment pooling downstream
+    mx = jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits - mx)
+    probs = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(v.dtype)
+    ctx = jax.lax.dot_general(
+        probs,
+        v,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [k, s, hd] f32
+    out_ref[:] = ctx.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "heads_per_step"))
+def fused_attention_tiled_seg(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: jax.Array,
+    scale: float,
+    heads_per_step: int = 8,
+) -> jax.Array:
+    """q/k/v[b, s, nh, hd], segment_ids[b, s] int32 (0 = pad slot) ->
+    ctx[b, s, nh, hd] with attention confined to same-segment tokens.
+
+    The packed-serving twin of ``fused_attention_tiled``: same grid/tile
+    layout and numerics, but the key-side padding bias is replaced by an
+    in-kernel segment equality mask so one dense row can carry many
+    independent sequences (serve/packing.py builds the layout).  VMEM
+    cost matches the padded kernel (the int32 seg row replaces the f32
+    bias row), so ``best_heads_per_step`` applies unchanged.
+    """
+    b, s, nh, hd = q.shape
+    kk = heads_per_step
+    if (b * nh) % kk:
+        raise ValueError(f"heads_per_step={kk} must divide b*nh={b * nh}")
+    grid = (b * nh // kk,)
+
+    def to_heads(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * nh, s, hd)
+
+    flat_seg = jnp.broadcast_to(
+        segment_ids.astype(jnp.int32)[:, None, :], (b, nh, s)
+    ).reshape(b * nh, 1, s)
+    qkv_spec = pl.BlockSpec(
+        (kk, s, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    seg_spec = pl.BlockSpec(
+        (kk, 1, s), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel_tiled_seg, scale=scale),
+        grid=grid,
+        in_specs=[qkv_spec, qkv_spec, qkv_spec, seg_spec],
+        out_specs=qkv_spec,
+        out_shape=jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
+        interpret=_interpret(),
+    )(to_heads(q), to_heads(k), to_heads(v), flat_seg)
+    return out.reshape(b, nh, s, hd).transpose(0, 2, 1, 3)
+
+
 def best_heads_per_step(
     b: int, s: int, nh: int, hd: int, itemsize: int = 2
 ) -> int:

@@ -545,7 +545,11 @@ class _ArchivingClient:
 
 
 def _warmup_embedder(
-    embedder, specs: list, r_buckets: list = (), aot: bool = True
+    embedder,
+    specs: list,
+    r_buckets: list = (),
+    aot: bool = True,
+    packed_buckets: list = (),
 ) -> None:
     """Pre-compile the consensus path for the given ``NxS`` shapes at
     startup (WARMUP env, serve/config.py) so the first real request
@@ -567,7 +571,13 @@ def _warmup_embedder(
     dispatch) and serves warmed buckets from the embedder's executable
     table — zero jit specializations after startup.  Mesh-sharded
     embedders fall back to the dispatch loop below (the AOT lowering
-    doesn't carry their input shardings)."""
+    doesn't carry their input shardings).
+
+    ``packed_buckets`` ((B, L, K) triples, wired from the PACKING_*
+    knobs) additionally warms the continuous-batching entry
+    (``bert.embed_packed``) at each packed-capacity bucket — the small
+    fixed set replacing the (R, N, S) lattice on the packed path.  AOT
+    only: packing itself requires the single-device embedder."""
     import logging
     import time as _time
 
@@ -584,7 +594,9 @@ def _warmup_embedder(
         )
     )
     if aot and embedder._aot_ready():
-        for label, dt in embedder.aot_warmup(snapped, r_buckets):
+        for label, dt in embedder.aot_warmup(
+            snapped, r_buckets, packed_buckets=packed_buckets
+        ):
             log.info("warmup AOT %s compiled in %.1fs", label, dt)
         return
     for n, s in snapped:
@@ -723,8 +735,31 @@ def build_service(
     # allowed (still logged); production startup refuses them
     embedder = build_embedder(config, allow_synthetic=fake_upstream)
     if embedder is not None and config.warmup:
+        packed_buckets = []
+        if config.packing_enabled and embedder.supports_packing():
+            # the hot packed-capacity buckets (serve/packing.py): every
+            # pow2 row count up to the per-call cap at full seq width
+            # (saturated bursts), plus the single-row call at each
+            # narrower seq bucket (lone small requests).  Cold (B, L)
+            # pairs off this set ride the jit path — log-bounded by the
+            # pow2 x ladder lattice
+            from .packing import _L_BUCKETS
+
+            l_top = config.packing_row_tokens
+            k = config.packing_max_segments
+            b = 1
+            while b <= config.packing_max_rows:
+                packed_buckets.append((b, l_top, k))
+                b *= 2
+            packed_buckets.extend(
+                (1, l, k) for l in _L_BUCKETS if l < l_top
+            )
         _warmup_embedder(
-            embedder, config.warmup, config.warmup_r, aot=config.warmup_aot
+            embedder,
+            config.warmup,
+            config.warmup_r,
+            aot=config.warmup_aot,
+            packed_buckets=packed_buckets,
         )
     reranker = build_reranker(config, allow_synthetic=fake_upstream)
     from .metrics import Metrics
@@ -784,6 +819,12 @@ def build_service(
             max_batch=config.batch_max,
             pipeline_depth=config.batch_pipeline,
             max_rows=config.batch_max_rows,
+            packing=config.packing_enabled,
+            packing_row_tokens=config.packing_row_tokens,
+            packing_max_rows=config.packing_max_rows,
+            packing_max_segments=config.packing_max_segments,
+            prefix_dedup=config.prefix_dedup,
+            prefix_dedup_min_chars=config.prefix_dedup_min_chars,
             embed_cache=embed_cache,
             max_queue_depth=config.admission_max_queue_depth,
             watchdog=watchdog,

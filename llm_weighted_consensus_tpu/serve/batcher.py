@@ -86,9 +86,42 @@ class DeviceBatcher:
         watchdog=None,
         fallback_embedder=None,
         fallback_context=None,
+        packing: bool = False,
+        packing_row_tokens: int = 512,
+        packing_max_rows: int = 8,
+        packing_max_segments: int = 64,
+        prefix_dedup: bool = True,
+        prefix_dedup_min_chars: int = 48,
     ) -> None:
         self.embedder = embedder
         self.metrics = metrics
+        # continuous batching (PACKING_ENABLED): embed + consensus items
+        # share ONE dispatch key and ride the ragged segment-id layout
+        # (serve/packing.py) instead of the per-kind padded buckets;
+        # opt-in — the padded path stays the default contract.  Requires
+        # the single-device embedder (packed layout bypasses mesh hooks).
+        self.packing = bool(packing) and bool(
+            getattr(embedder, "supports_packing", lambda: False)()
+        )
+        self.packing_row_tokens = max(16, int(packing_row_tokens))
+        self.packing_max_rows = max(1, int(packing_max_rows))
+        self.packing_max_segments = max(1, int(packing_max_segments))
+        # shared-prefix dedup (PREFIX_DEDUP, packed path only): a
+        # consensus request's N candidates usually share the conversation
+        # prefix; embed it ONCE as its own segment and compose
+        # per-candidate embeddings from (prefix, suffix) part vectors
+        self.prefix_dedup = bool(prefix_dedup)
+        self.prefix_dedup_min_chars = max(1, int(prefix_dedup_min_chars))
+        # packing efficiency accounting (satellite: /metrics): real vs
+        # dispatched token slots per path, dedup hits, bucket occupancy
+        self._pack_real_tokens = 0
+        self._pack_slot_tokens = 0
+        self._pad_real_tokens = 0
+        self._pad_slot_tokens = 0
+        self.prefix_dedup_hits = 0
+        self.prefix_dedup_tokens_saved = 0
+        self.packed_fallback_items = 0
+        self._packed_occupancy: dict = {}
         # bounded queue (ADMISSION_MAX_QUEUE_DEPTH): arrivals beyond
         # this many pending items fail fast with OverloadedError (503)
         # instead of growing the queue without limit; 0 = unbounded
@@ -169,10 +202,11 @@ class DeviceBatcher:
         than recomputed, and only genuinely new rows ride a dispatch.
         The public contract is unchanged either way."""
         texts = list(texts)
+        key = self._embed_key(max_tokens)
         cache = self.embed_cache
         if cache is None or not cache.enabled or not texts:
             emb, row_tokens = await self._submit(
-                "embed", ("embed", max_tokens), (texts, max_tokens)
+                "embed", key, (texts, max_tokens)
             )
             return emb, int(np.asarray(row_tokens).sum())
         from ..cache.fingerprint import embed_fingerprint
@@ -204,7 +238,7 @@ class DeviceBatcher:
         if submit_texts:
             try:
                 emb, row_tokens = await self._submit(
-                    "embed", ("embed", max_tokens), (submit_texts, max_tokens)
+                    "embed", key, (submit_texts, max_tokens)
                 )
             except BaseException as e:
                 for fp in submit_fps:
@@ -240,7 +274,7 @@ class DeviceBatcher:
         if retry:
             emb, row_tokens = await self._submit(
                 "embed",
-                ("embed", max_tokens),
+                key,
                 ([texts[i] for i in retry], max_tokens),
             )
             row_tokens = np.asarray(row_tokens)
@@ -257,12 +291,28 @@ class DeviceBatcher:
         token count from the SAME tokenization (callers must not
         re-tokenize on the event loop for usage accounting).  Batches
         with same-N same-temperature requests via
-        ``consensus_confidence_tokens_many``."""
+        ``consensus_confidence_tokens_many`` — or, with packing enabled,
+        with EVERY other packed-eligible item regardless of N and
+        temperature (the packed dispatch votes per item on host)."""
+        key = (
+            ("packed",)
+            if self.packing
+            else ("consensus", len(texts), float(temperature))
+        )
         return await self._submit(
             "consensus",
-            ("consensus", len(texts), float(temperature)),
+            key,
             (list(texts), temperature),
         )
+
+    def _embed_key(self, max_tokens):
+        """Grouping key for embed items: packed mode groups across
+        max_tokens caps (each item tokenizes under its own cap on the
+        device thread); the padded path tokenizes the whole group with
+        one cap, so the cap stays in the key."""
+        if self.packing:
+            return ("packed",)
+        return ("embed", max_tokens)
 
     async def stream_update(
         self,
@@ -348,6 +398,38 @@ class DeviceBatcher:
             "cancelled_items": self.cancelled_items,
             "fallback_active": self._use_fallback,
             "fallback_dispatches": self.fallback_dispatches,
+            # packing-efficiency counters (ISSUE 7): real tokens actually
+            # embedded vs device slots dispatched, per path — the padding
+            # waste the packed layout exists to reclaim
+            "packing": {
+                "enabled": self.packing,
+                "real_tokens": self._pack_real_tokens,
+                "slot_tokens": self._pack_slot_tokens,
+                "padding_waste": round(
+                    1.0 - self._pack_real_tokens / self._pack_slot_tokens,
+                    4,
+                )
+                if self._pack_slot_tokens
+                else 0.0,
+                "prefix_dedup_hits": self.prefix_dedup_hits,
+                "prefix_dedup_tokens_saved": self.prefix_dedup_tokens_saved,
+                "fallback_items": self.packed_fallback_items,
+                # packed row-bucket B -> device calls at that bucket
+                "bucket_occupancy": {
+                    str(b): c
+                    for b, c in sorted(self._packed_occupancy.items())
+                },
+            },
+            "padded": {
+                "real_tokens": self._pad_real_tokens,
+                "slot_tokens": self._pad_slot_tokens,
+                "padding_waste": round(
+                    1.0 - self._pad_real_tokens / self._pad_slot_tokens,
+                    4,
+                )
+                if self._pad_slot_tokens
+                else 0.0,
+            },
         }
 
     # -- internals -----------------------------------------------------------
@@ -416,30 +498,32 @@ class DeviceBatcher:
         inflight: set = set()
         while self._pending or inflight:
             if self._pending:
-                batch, self._pending = self._pending, []
-                for group in self._group(batch):
-                    # bounded pipelining: block here (arrivals keep
-                    # appending to _pending) until a dispatch slot frees
-                    await self._sem.acquire()
-                    # the slot is owned here until _run_group takes it:
-                    # release on every non-handoff exit (shed-to-empty,
-                    # _shed_group raising) or the pipeline wedges one
-                    # depth shallower per leak
-                    handed_off = False
-                    try:
-                        # shed AFTER the slot wait — that queueing delay
-                        # is exactly where deadlines die under overload
-                        group = self._shed_group(group)
-                        if group:
-                            task = loop.create_task(
-                                self._run_group(loop, group)
-                            )
-                            inflight.add(task)
-                            task.add_done_callback(inflight.discard)
-                            handed_off = True
-                    finally:
-                        if not handed_off:
-                            self._sem.release()
+                # bounded pipelining: wait for a dispatch slot FIRST and
+                # only then plan ONE group from whatever is pending —
+                # continuous admission: items arriving while earlier
+                # groups hold the device join the NEXT dispatch group
+                # instead of waiting behind a plan made before they
+                # existed (the old snapshot-everything drain)
+                await self._sem.acquire()
+                # the slot is owned here until _run_group takes it:
+                # release on every non-handoff exit (shed-to-empty,
+                # _shed_group raising) or the pipeline wedges one
+                # depth shallower per leak
+                handed_off = False
+                try:
+                    # shed AFTER the slot wait — that queueing delay
+                    # is exactly where deadlines die under overload
+                    group = self._shed_group(self._next_group())
+                    if group:
+                        task = loop.create_task(
+                            self._run_group(loop, group)
+                        )
+                        inflight.add(task)
+                        task.add_done_callback(inflight.discard)
+                        handed_off = True
+                finally:
+                    if not handed_off:
+                        self._sem.release()
             else:
                 # park until a dispatch finishes OR a new item arrives
                 # (_submit sets the wake event) — a free pipeline slot
@@ -454,6 +538,69 @@ class DeviceBatcher:
                     )
                 finally:
                     waker.cancel()
+
+    @staticmethod
+    def _est_kind(item) -> str:
+        """The EWMA/metrics series an item's dispatch runs under: packed
+        groups mix embed and consensus kinds, so they estimate and report
+        as one "packed" series."""
+        return "packed" if item.key and item.key[0] == "packed" else item.kind
+
+    def _next_group(self) -> list:
+        """Plan ONE dispatch group from the live pending queue: the head
+        item's key, joined by every same-key arrival (order preserved) up
+        to ``max_batch`` items and the row budget; everything else stays
+        pending for the next iteration.  Planning one group at a time —
+        AFTER the pipeline-slot wait — is what makes the batcher
+        continuous: work that arrives during an in-flight dispatch is in
+        ``self._pending`` by the time this runs, so it rides the very
+        next group instead of a pre-made plan.
+
+        Consensus groups keep the pow2-chunk policy (``_pow2_chunks``):
+        the first chunk dispatches now, the remainder returns to the
+        FRONT of the queue (they are the oldest same-key items) and
+        dispatches next iteration — same chunk sizes as the snapshot
+        drain, one slot apart."""
+        pending = self._pending
+        if not pending:
+            return []
+        key = pending[0].key
+        # packed groups are bounded by estimated SEGMENTS (one packed
+        # call's worth at a time — the dispatch may still split into
+        # multiple bucket calls); padded groups by encoder rows
+        row_budget = (
+            self.packing_max_rows * self.packing_max_segments
+            if key and key[0] == "packed"
+            else self.max_rows
+        )
+        take: list = []
+        rest: list = []
+        rows = 0
+        closed = False  # once one same-key item misses the budget, later
+        # same-key items must not jump it (per-key FIFO is the contract)
+        for item in pending:
+            r = self._rows(item)
+            if (
+                item.key == key
+                and not closed
+                and len(take) < self.max_batch
+                and (not take or rows + r <= row_budget)
+            ):
+                take.append(item)
+                rows += r
+            else:
+                if item.key == key:
+                    closed = True
+                rest.append(item)
+        self._pending = rest
+        if take and take[0].kind == "consensus" and key[0] == "consensus":
+            chunks = list(self._pow2_chunks(take))
+            if len(chunks) > 1:
+                self._pending = [
+                    i for c in chunks[1:] for i in c
+                ] + self._pending
+                take = chunks[0]
+        return take
 
     def _shed_group(self, group: list) -> list:
         """Items still worth dispatching: drops items whose caller
@@ -470,7 +617,7 @@ class DeviceBatcher:
                 continue
             deadline = item.deadline
             if deadline is not None:
-                estimate = self._ewma_ms.get(item.kind)
+                estimate = self._ewma_ms.get(self._est_kind(item))
                 doomed = deadline.expired() or (
                     estimate is not None
                     and deadline.remaining() * 1e3 < estimate
@@ -511,7 +658,7 @@ class DeviceBatcher:
         ]
         error = False
         wd_token = (
-            self.watchdog.begin(group[0].kind)
+            self.watchdog.begin(self._est_kind(group[0]))
             if self.watchdog is not None
             else None
         )
@@ -546,11 +693,12 @@ class DeviceBatcher:
         self._busy.append((t0, end))
         self._dispatches += 1
         self._items += len(group)
+        series = self._est_kind(group[0])
         if not error:
             # warm per-kind dispatch-time estimate for the deadline shed
             ms = (end - t0) * 1e3
-            prev = self._ewma_ms.get(group[0].kind)
-            self._ewma_ms[group[0].kind] = (
+            prev = self._ewma_ms.get(series)
+            self._ewma_ms[series] = (
                 ms if prev is None else 0.8 * prev + 0.2 * ms
             )
         if self.metrics is not None:
@@ -566,7 +714,7 @@ class DeviceBatcher:
                 None,
             )
             self.metrics.observe(
-                f"device:batch:{group[0].kind}",
+                f"device:batch:{series}",
                 (end - t0) * 1e3,
                 error=error,
                 trace_id=trace_id,
@@ -649,7 +797,10 @@ class DeviceBatcher:
     # -- dispatch implementations (device thread) ------------------------------
 
     def _dispatch(self, group: list) -> list:
-        fn = getattr(self, "_dispatch_" + group[0].kind)
+        if group[0].key and group[0].key[0] == "packed":
+            fn = self._dispatch_packed
+        else:
+            fn = getattr(self, "_dispatch_" + group[0].kind)
         if self._use_fallback and self.fallback_embedder is not None:
             self.fallback_dispatches += 1
             if self.fallback_context is not None:
@@ -670,6 +821,7 @@ class DeviceBatcher:
             texts.extend(t)
             counts.append(len(t))
         ids, mask = embedder.tokenize(texts, max_tokens)
+        self._count_padded(embedder, ids, mask)
         emb = embedder.embed_tokens(ids, mask)
         tokens = mask.sum(axis=1)
         out = []
@@ -692,6 +844,8 @@ class DeviceBatcher:
         n = len(texts0)
         if len(group) == 1:
             ids, mask = embedder.tokenize(texts0)
+            self._pad_real_tokens += int(mask.sum())
+            self._pad_slot_tokens += int(ids.size)
             conf = np.asarray(
                 embedder.consensus_confidence_tokens(
                     ids, mask, temperature
@@ -701,6 +855,11 @@ class DeviceBatcher:
         all_texts = [t for item in group for t in item.payload[0]]
         ids, mask = embedder.tokenize(all_texts)
         r = len(group)
+        from ..utils import next_pow2
+
+        # the grouped dispatch pads the request dim to its pow2 bucket
+        self._pad_real_tokens += int(mask.sum())
+        self._pad_slot_tokens += int(next_pow2(r) * n * ids.shape[1])
         conf = np.asarray(
             embedder.consensus_confidence_tokens_many(
                 ids.reshape(r, n, -1), mask.reshape(r, n, -1), temperature
@@ -708,6 +867,198 @@ class DeviceBatcher:
         )
         tokens = mask.reshape(r, n, -1).sum(axis=(1, 2))
         return [(conf[i], int(tokens[i])) for i in range(r)]
+
+    def _count_padded(self, embedder, ids, mask) -> None:
+        """Padded-path efficiency accounting for an embed dispatch: real
+        tokens vs the row-bucketed slot count ``embed_tokens`` pads to."""
+        self._pad_real_tokens += int(mask.sum())
+        try:
+            from ..models.embedder import _bucket
+
+            pad_b = _bucket(
+                ids.shape[0], getattr(embedder, "MAX_DEVICE_BATCH", 4096)
+            )
+        except Exception:
+            pad_b = ids.shape[0]
+        self._pad_slot_tokens += int(pad_b * ids.shape[1])
+
+    # -- packed (continuous-batching) dispatch --------------------------------
+
+    def _dispatch_packed(self, group: list, embedder) -> list:
+        """One mixed group (embed + consensus items, any N, any cap) ->
+        per-item results through the ragged segment-id layout.
+
+        Per item: tokenize ragged segments under the item's own cap
+        (consensus items optionally splitting into ONE shared-prefix
+        segment + N suffix segments), first-fit pack every segment in the
+        group into ("packed", B, L, K) bucket calls, run
+        ``embedder.embed_packed`` per call, then reassemble: embed items
+        gather their per-text vectors; consensus items compose candidate
+        vectors (prefix-weighted when deduped) and vote ON HOST
+        (``packing.consensus_vote_np`` — numerics-matched to the device
+        vote) so mixed-N requests share a dispatch without per-N jit
+        specializations.  Items whose sequences exceed the packed row
+        fall back to their padded dispatch, inside this same group."""
+        from . import packing as _packing
+
+        if not (
+            getattr(embedder, "embed_packed", None) is not None
+            and getattr(embedder, "supports_packing", lambda: False)()
+        ):
+            # e.g. the CPU-fallback or a mesh-sharded embedder mid-swap:
+            # serve every item through its padded path, one by one
+            return [self._packed_item_fallback(item, embedder) for item in group]
+        row_tokens = self.packing_row_tokens
+        seg_cap = min(row_tokens, embedder.max_tokens)
+        segments: list = []  # ragged int32 token rows, group-global
+        plans: list = []  # one assembly plan per item
+        for item in group:
+            plans.append(
+                self._plan_packed_item(
+                    item, embedder, segments, seg_cap, row_tokens
+                )
+            )
+        results: list = [None] * len(group)
+        seg_vecs: list = [None] * len(segments)
+        if segments:
+            calls = _packing.build_calls(
+                segments,
+                row_tokens,
+                self.packing_max_rows,
+                self.packing_max_segments,
+            )
+            for call in calls:
+                out = embedder.embed_packed(
+                    call.ids, call.segment_ids, call.positions,
+                    call.seg_starts,
+                )
+                self._pack_real_tokens += call.real_tokens
+                self._pack_slot_tokens += call.slot_tokens
+                b = call.ids.shape[0]
+                self._packed_occupancy[b] = (
+                    self._packed_occupancy.get(b, 0) + 1
+                )
+                for si, (r, slot) in call.slots.items():
+                    seg_vecs[si] = np.asarray(out[r, slot], np.float32)
+        for i, (item, plan) in enumerate(zip(group, plans)):
+            results[i] = self._assemble_packed_item(
+                item, plan, segments, seg_vecs, embedder
+            )
+        return results
+
+    def _plan_packed_item(
+        self, item, embedder, segments: list, seg_cap: int, row_tokens: int
+    ):
+        """Tokenize one item into group-global segments and return its
+        assembly plan; oversized items plan as ("fallback",)."""
+        from . import packing as _packing
+
+        if item.kind == "embed":
+            texts, cap = item.payload
+            rows = embedder.tokenize_ragged(
+                texts, min(cap, seg_cap) if cap else seg_cap
+            )
+            if any(not 0 < len(r) <= row_tokens for r in rows):
+                return ("fallback",)
+            base = len(segments)
+            segments.extend(rows)
+            return ("embed", list(range(base, base + len(rows))))
+        texts, temperature = item.payload
+        prefix = (
+            _packing.shared_prefix(texts, self.prefix_dedup_min_chars)
+            if self.prefix_dedup
+            else None
+        )
+        if prefix is not None:
+            parts = [prefix] + [t[len(prefix) :] for t in texts]
+            # empty suffixes (candidate == prefix) embed nothing: their
+            # candidate vector IS the prefix vector
+            part_texts = [parts[0]] + [s for s in parts[1:] if s]
+            rows = embedder.tokenize_ragged(part_texts, seg_cap)
+            # a prefix this short is all [CLS]/[SEP] overhead — or the
+            # pieces no longer fit the packed row: vote on full texts
+            if len(rows[0]) >= 4 and all(
+                0 < len(r) <= row_tokens for r in rows
+            ):
+                base = len(segments)
+                segments.extend(rows)
+                seg_iter = iter(range(base + 1, base + len(rows)))
+                suffix_segs = [
+                    next(seg_iter) if s else None for s in parts[1:]
+                ]
+                self.prefix_dedup_hits += len(texts) - 1
+                self.prefix_dedup_tokens_saved += (
+                    len(texts) - 1
+                ) * len(rows[0])
+                return ("consensus_dedup", base, suffix_segs, temperature)
+        rows = embedder.tokenize_ragged(texts, seg_cap)
+        if any(not 0 < len(r) <= row_tokens for r in rows):
+            return ("fallback",)
+        base = len(segments)
+        segments.extend(rows)
+        return (
+            "consensus",
+            list(range(base, base + len(rows))),
+            temperature,
+        )
+
+    def _assemble_packed_item(
+        self, item, plan, segments: list, seg_vecs: list, embedder
+    ):
+        from . import packing as _packing
+
+        if plan[0] == "fallback":
+            self.packed_fallback_items += 1
+            return self._packed_item_fallback(item, embedder)
+        if plan[0] == "embed":
+            idxs = plan[1]
+            emb = np.stack([seg_vecs[i] for i in idxs]).astype(
+                np.float32, copy=False
+            )
+            tokens = np.asarray([len(segments[i]) for i in idxs])
+            return (emb, tokens)
+        if plan[0] == "consensus_dedup":
+            _, prefix_idx, suffix_segs, temperature = plan
+            p_vec = seg_vecs[prefix_idx]
+            p_tok = len(segments[prefix_idx])
+            cand = np.stack(
+                [
+                    _packing.compose_prefix_suffix(
+                        p_vec,
+                        p_tok,
+                        seg_vecs[si] if si is not None else None,
+                        len(segments[si]) if si is not None else 0,
+                    )
+                    for si in suffix_segs
+                ]
+            )
+            conf = _packing.consensus_vote_np(cand, temperature)
+            tokens = p_tok + sum(
+                len(segments[si]) for si in suffix_segs if si is not None
+            )
+            return (conf, int(tokens))
+        _, idxs, temperature = plan
+        cand = np.stack([seg_vecs[i] for i in idxs])
+        conf = _packing.consensus_vote_np(cand, temperature)
+        return (conf, int(sum(len(segments[i]) for i in idxs)))
+
+    def _packed_item_fallback(self, item, embedder):
+        """Serve one packed-key item through its padded dispatch (the
+        packed row cannot hold it, or the embedder cannot pack)."""
+        if item.kind == "embed":
+            texts, cap = item.payload
+            ids, mask = embedder.tokenize(texts, cap)
+            self._count_padded(embedder, ids, mask)
+            emb = embedder.embed_tokens(ids, mask)
+            return (emb, mask.sum(axis=1))
+        texts, temperature = item.payload
+        ids, mask = embedder.tokenize(texts)
+        self._pad_real_tokens += int(mask.sum())
+        self._pad_slot_tokens += int(ids.size)
+        conf = np.asarray(
+            embedder.consensus_confidence_tokens(ids, mask, temperature)
+        )
+        return (conf, int(mask.sum()))
 
     def _dispatch_stream(self, group: list, embedder) -> list:
         if len(group) == 1:

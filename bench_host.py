@@ -134,9 +134,11 @@ def build_engine(judges: int, n: int, requests: int, seed: int):
 
 def analysis_time_record() -> dict:
     """--analysis-time: wall time of the full-package invariant checker
-    (the tier-1 analysis gate), budgeted at 30 s on CPU.  The AST lint
-    runs in-process (stdlib only); the jaxpr audit runs in a subprocess
-    so this process keeps its device-free / no-jax guarantee."""
+    (the tier-1 analysis gate): AST lint budgeted within the original
+    30 s with the jaxpr audit, plus the simulated-mesh sharding/resource
+    audit with its own 60 s budget.  The AST lint runs in-process
+    (stdlib only); the jaxpr and mesh audits run in subprocesses so this
+    process keeps its device-free / no-jax guarantee."""
     import subprocess
 
     from llm_weighted_consensus_tpu.analysis import (
@@ -165,22 +167,48 @@ def analysis_time_record() -> dict:
     )
     jaxpr_s = time.perf_counter() - t0
 
-    total_s = lint_s + jaxpr_s
+    # run_mesh_audit self-respawns with the 8-virtual-device env; calling
+    # it via -c (not in-process) keeps this bench jax-free either way
+    mesh_budget_s = 60
+    t0 = time.perf_counter()
+    mesh_proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys\n"
+            "from llm_weighted_consensus_tpu.analysis.mesh_audit import "
+            "run_mesh_audit\n"
+            "sys.exit(1 if run_mesh_audit() else 0)",
+        ],
+        env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=mesh_budget_s * 2,
+    )
+    mesh_s = time.perf_counter() - t0
+
+    total_s = lint_s + jaxpr_s + mesh_s
     return {
-        "metric": "full-package analysis wall time (AST lint + jaxpr audit)",
+        "metric": (
+            "full-package analysis wall time "
+            "(AST lint + jaxpr audit + mesh audit)"
+        ),
         "value": round(total_s, 3),
         "unit": "s",
         "lint_seconds": round(lint_s, 3),
         "jaxpr_seconds": round(jaxpr_s, 3),
+        "mesh_seconds": round(mesh_s, 3),
         "lint_findings": len(kept),
         "stale_baseline": len(stale),
         "jaxpr_clean": proc.returncode == 0,
+        "mesh_clean": mesh_proc.returncode == 0,
         "budget_seconds": 30,
-        "within_budget": total_s < 30,
+        "within_budget": lint_s + jaxpr_s < 30,
+        "mesh_budget_seconds": mesh_budget_s,
+        "mesh_within_budget": mesh_s < mesh_budget_s,
         "jax_imported": "jax" in sys.modules,
         "note": (
-            "lint in-process (stdlib ast only), jaxpr audit in a "
-            "JAX_PLATFORMS=cpu subprocess so the host bench process "
+            "lint in-process (stdlib ast only), jaxpr + mesh audits in "
+            "JAX_PLATFORMS=cpu subprocesses so the host bench process "
             "stays jax-free"
         ),
     }
@@ -206,6 +234,10 @@ def main() -> None:
             "host bench must stay device-free"
         )
         print(json.dumps(record), flush=True)
+        assert record["mesh_within_budget"], (
+            f"mesh audit took {record['mesh_seconds']}s, budget "
+            f"{record['mesh_budget_seconds']}s"
+        )
         return
 
     from bench import BASELINE_BASIS, make_requests

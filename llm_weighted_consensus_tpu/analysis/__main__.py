@@ -1,7 +1,8 @@
 """CLI: ``python -m llm_weighted_consensus_tpu.analysis``.
 
-Runs the AST lint over the package, then the jaxpr audit (unless
-skipped), applies ``baseline.json``, and reports.
+Runs the AST lint over the package, then the jaxpr audit and the
+simulated-mesh sharding/resource audit (unless skipped), applies
+``baseline.json``, and reports.
 
 Exit codes: **0** clean (every finding baselined or none), **1**
 non-baselined findings, **2** baseline problems (a stale suppression —
@@ -9,12 +10,15 @@ the code it covered was fixed, so the entry must be deleted — or an
 entry missing its mandatory ``reason``).
 
 Flags/env: ``--no-jaxpr`` or ``ANALYSIS_SKIP_JAXPR=1`` skips the jaxpr
-audit (lint stays); ``--baseline PATH`` / ``ANALYSIS_BASELINE``
-overrides the baseline file; ``--rules LWC001,...`` restricts lint
-rules; ``--json`` emits machine-readable findings; positional paths
-lint specific files instead of the whole package.  The jaxpr audit's
-own knobs (``ANALYSIS_JAXPR_MODEL`` / ``_SPECS`` / ``_R_BUCKETS``) are
-documented in ``jaxpr_audit.py``.
+audit (lint stays); ``--no-mesh`` or ``ANALYSIS_SKIP_MESH=1`` skips the
+mesh audit; ``--baseline PATH`` / ``ANALYSIS_BASELINE`` overrides the
+baseline file; ``--rules LWC001,...`` restricts lint rules; ``--json``
+emits machine-readable findings; positional paths lint specific files
+instead of the whole package.  The jaxpr audit's own knobs
+(``ANALYSIS_JAXPR_MODEL`` / ``_SPECS`` / ``_R_BUCKETS``) are documented
+in ``jaxpr_audit.py``; the mesh audit's (``ANALYSIS_MESH_MODEL`` /
+``_DP`` / ``_TP`` / ``_SPECS`` / ``_R_BUCKETS`` / ``_PACKED_BUCKETS``,
+``ANALYSIS_BUDGETS``) in ``mesh_audit.py``.
 """
 
 from __future__ import annotations
@@ -46,6 +50,11 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--no-jaxpr", action="store_true",
         help="skip the jaxpr serving-path audit (ANALYSIS_SKIP_JAXPR=1)",
+    )
+    parser.add_argument(
+        "--no-mesh", action="store_true",
+        help="skip the simulated-mesh sharding/resource audit "
+        "(ANALYSIS_SKIP_MESH=1)",
     )
     parser.add_argument(
         "--baseline", type=Path, default=None,
@@ -92,6 +101,15 @@ def main(argv=None) -> int:
         findings += run_jaxpr_audit()
         jaxpr_s = time.perf_counter() - t0
 
+    mesh_s = 0.0
+    skip_mesh = args.no_mesh or bool(os.environ.get("ANALYSIS_SKIP_MESH"))
+    if not skip_mesh:
+        from .mesh_audit import run_mesh_audit
+
+        t0 = time.perf_counter()
+        findings += run_mesh_audit()
+        mesh_s = time.perf_counter() - t0
+
     baseline_path = args.baseline or (
         Path(os.environ["ANALYSIS_BASELINE"])
         if os.environ.get("ANALYSIS_BASELINE")
@@ -113,6 +131,7 @@ def main(argv=None) -> int:
                     "stale_baseline": stale,
                     "lint_seconds": round(lint_s, 3),
                     "jaxpr_seconds": round(jaxpr_s, 3),
+                    "mesh_seconds": round(mesh_s, 3),
                 }
             )
         )
@@ -125,6 +144,8 @@ def main(argv=None) -> int:
         )
         if not skip_jaxpr:
             summary += f", jaxpr audit {jaxpr_s:.2f}s"
+        if not skip_mesh:
+            summary += f", mesh audit {mesh_s:.2f}s"
         print(summary, file=sys.stderr)
 
     if stale:

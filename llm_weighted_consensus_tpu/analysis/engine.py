@@ -144,6 +144,24 @@ def call_base(call: ast.Call) -> Optional[str]:
     return None
 
 
+def enclosing_symbol(
+    module: ParsedModule, node: ast.AST
+) -> Optional[str]:
+    """Qualname of the innermost function whose span contains ``node``
+    (None for module/class-level code) — the baseline symbol key for
+    rules that walk the whole tree instead of per-function bodies."""
+    line = getattr(node, "lineno", 0)
+    best = None
+    best_span = None
+    for fn in module.functions():
+        end = getattr(fn.node, "end_lineno", fn.node.lineno)
+        if fn.node.lineno <= line <= end:
+            span = end - fn.node.lineno
+            if best_span is None or span < best_span:
+                best, best_span = fn.qualname, span
+    return best
+
+
 def dotted_name(node: ast.AST) -> Optional[str]:
     """``a.b.c`` / ``a`` -> its dotted source; None otherwise."""
     if isinstance(node, ast.Name):
@@ -194,16 +212,23 @@ def run_lint(
     rules: Optional[Sequence] = None,
     rel_to: Optional[Path] = None,
 ) -> List[Finding]:
-    """Parse every file once, run every rule over each parsed module."""
+    """Parse every file once, run every rule over each parsed module,
+    then every project-scoped rule over the whole parsed set (rules
+    whose invariant spans modules — e.g. declared-vs-used registries —
+    set ``Rule.project`` instead of/alongside ``check``)."""
     from .rules import ALL_RULES
 
     rules = list(rules) if rules is not None else list(ALL_RULES)
     files = list(paths) if paths is not None else source_files()
+    modules = [parse_module(path, rel_to=rel_to) for path in files]
     findings: List[Finding] = []
-    for path in files:
-        module = parse_module(path, rel_to=rel_to)
+    for module in modules:
         for rule in rules:
-            findings.extend(rule.check(module))
+            if rule.check is not None:
+                findings.extend(rule.check(module))
+    for rule in rules:
+        if getattr(rule, "project", None) is not None:
+            findings.extend(rule.project(modules))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 

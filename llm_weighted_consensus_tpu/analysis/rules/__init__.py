@@ -10,7 +10,7 @@ iterate; adding a rule means adding a module here and one line below
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, List, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from ..engine import Finding, ParsedModule
 
@@ -19,7 +19,13 @@ from ..engine import Finding, ParsedModule
 class Rule:
     name: str
     summary: str
-    check: Callable[[ParsedModule], List[Finding]]
+    # per-module check; None for purely project-scoped rules
+    check: Optional[Callable[[ParsedModule], List[Finding]]]
+    # whole-parsed-set check (declared-vs-used registries and other
+    # cross-module invariants); runs once after every module is parsed
+    project: Optional[
+        Callable[[List[ParsedModule]], List[Finding]]
+    ] = None
 
 
 from . import (  # noqa: E402
@@ -30,6 +36,10 @@ from . import (  # noqa: E402
     lwc005_decimal_purity,
     lwc006_blocking_in_async,
     lwc007_envelope_kind,
+    lwc008_env_read_outside_config,
+    lwc009_jax_in_async,
+    lwc010_registry_consistency,
+    lwc011_config_readme_drift,
 )
 
 ALL_RULES: Tuple[Rule, ...] = (
@@ -40,6 +50,10 @@ ALL_RULES: Tuple[Rule, ...] = (
     lwc005_decimal_purity.RULE,
     lwc006_blocking_in_async.RULE,
     lwc007_envelope_kind.RULE,
+    lwc008_env_read_outside_config.RULE,
+    lwc009_jax_in_async.RULE,
+    lwc010_registry_consistency.RULE,
+    lwc011_config_readme_drift.RULE,
 )
 
 RULES_BY_NAME = {rule.name: rule for rule in ALL_RULES}

@@ -1,0 +1,68 @@
+"""LWC009 — ``jax.*`` / ``jnp.*`` calls inside ``async def``.
+
+Device work belongs behind the batcher/embedder boundary: the batcher
+coroutine hands numpy batches to the embedder, whose jitted calls run
+device dispatch (and, off the AOT table, whole XLA compilations —
+seconds of blocking) on an executor thread.  A ``jax.*`` call directly
+inside any other coroutine stalls the event loop for every in-flight
+request AND dodges the jit-specialization accounting the JXA005 guard
+audits.
+
+Exempt modules (they ARE the boundary): ``serve/batcher.py`` and
+``models/embedder.py``.  Nested ``def``s/lambdas inside coroutines are
+not flagged (function-scoped contract — they usually run on the
+executor), but are linted as their own functions if async.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding, ParsedModule, body_nodes, dotted_name
+from . import Rule
+
+_EXEMPT_SUFFIXES = (
+    "serve/batcher.py",
+    "models/embedder.py",
+)
+
+
+def check(module: ParsedModule) -> List[Finding]:
+    if module.rel.endswith(_EXEMPT_SUFFIXES):
+        return []
+    findings: List[Finding] = []
+    for fn in module.functions():
+        if not fn.is_async:
+            continue
+        for node in body_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            if dotted is None:
+                continue
+            root = dotted.split(".", 1)[0]
+            if root not in ("jax", "jnp"):
+                continue
+            findings.append(
+                Finding(
+                    rule=RULE.name,
+                    path=module.rel,
+                    line=node.lineno,
+                    symbol=fn.qualname,
+                    message=(
+                        f"`{dotted}(...)` inside async def: device "
+                        "dispatch (or a surprise compile) blocks the "
+                        "event loop — route it through the batcher/"
+                        "embedder executor boundary"
+                    ),
+                )
+            )
+    return findings
+
+
+RULE = Rule(
+    name="LWC009",
+    summary="jax call inside async def outside the batcher/embedder",
+    check=check,
+)

@@ -51,6 +51,23 @@ def _gen_id(nbytes: int) -> str:
     return format(value, f"0{2 * nbytes}x")
 
 
+# Every span name the tree can contain; a trailing ``*`` covers a
+# dynamic suffix (f-string call sites).  The registry the LWC010 lint
+# checks both ways: a span started with an unlisted name fails lint
+# (trace queries and the explain renderer match on these), and a listed
+# name no call site uses is a stale entry to delete.
+KNOWN_SPANS = (
+    "gateway:*",
+    "batcher:*",
+    "device:dispatch",
+    "singleflight:wait",
+    "cache:lookup",
+    "consensus:tally",
+    "judge:stream",
+    "judge:attempt",
+)
+
+
 class Trace:
     """One request's span collection + the retention verdict inputs."""
 

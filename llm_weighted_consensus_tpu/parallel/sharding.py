@@ -15,6 +15,8 @@ all-reduce/reduce-scatter over ICI).  Batches shard over ``dp``.
 
 from __future__ import annotations
 
+import re
+
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -86,19 +88,175 @@ def bert_param_specs(tp: bool = True, quantized: bool = False) -> dict:
     }
 
 
-def shard_bert_params(params: dict, mesh: Mesh, tp: bool = True) -> dict:
-    """Place a bert param pytree on the mesh with the TP layout."""
-    from ..models.quant import is_quantized
+# --- first-class partition-rule tables -------------------------------------
+#
+# The dict tables above mirror the param tree shape; the rule tables below
+# are the audit-friendly dual: an ordered list of (name, anchored-regex,
+# spec) matched against the "/"-joined leaf path.  The contract the mesh
+# audit (analysis/mesh_audit.py, JXA006) enforces: every leaf matches
+# EXACTLY one rule, and every rule matches at least one leaf.
 
-    specs = bert_param_specs(
-        tp=tp and mesh.shape.get("tp", 1) > 1, quantized=is_quantized(params)
+_COL = ("attn_q", "attn_k", "attn_v", "mlp_in")
+_ROW = ("attn_out", "mlp_out")
+
+
+def _encoder_rules(quantized: bool = False) -> tuple:
+    """Shared bert/deberta encoder-layer rules.  ``quantized`` swaps the
+    dense kernel leaf for the int8 (kernel_q, scale) pair — scale is
+    per-OUT-channel, so it follows the kernel's last axis (split for
+    column kernels, replicated for row kernels)."""
+    col = "|".join(_COL)
+    row = "|".join(_ROW)
+    kernel = "kernel_q" if quantized else "kernel"
+    rules = [
+        (
+            "layer_col_kernel",
+            rf"layers/({col})/{kernel}",
+            P(None, None, "tp"),
+        ),
+        ("layer_col_bias", rf"layers/({col})/bias", P(None, "tp")),
+        (
+            "layer_row_kernel",
+            rf"layers/({row})/{kernel}",
+            P(None, "tp", None),
+        ),
+        ("layer_row_bias", rf"layers/({row})/bias", P(None)),
+        ("layer_ln", r"layers/(attn_ln|mlp_ln)/(scale|bias)", P(None)),
+    ]
+    if quantized:
+        rules[2:2] = [
+            ("layer_col_scale", rf"layers/({col})/scale", P(None, "tp")),
+        ]
+        rules[5:5] = [
+            ("layer_row_scale", rf"layers/({row})/scale", P(None, None)),
+        ]
+    return tuple(rules)
+
+
+def bert_partition_rules(quantized: bool = False) -> tuple:
+    """Ordered (name, regex, PartitionSpec) rules for models.bert trees."""
+    return (
+        ("embed_tables", r"(token|position|type)_embed", P()),
+        ("embed_ln", r"embed_ln/(scale|bias)", P()),
+    ) + _encoder_rules(quantized=quantized)
+
+
+def deberta_partition_rules(quantized: bool = False) -> tuple:
+    """Rules for models.deberta trees: bert encoder plus disentangled
+    position projections (column-split like q/k) and the reward head
+    (Megatron pair: dense column-split, scalar out row-split)."""
+    return (
+        ("embed_tables", r"(token|rel)_embed", P()),
+        ("embed_ln", r"(embed|rel)_ln/(scale|bias)", P()),
+        # pos_q/pos_k stay full-precision even in int8 trees (models.quant
+        # quantizes the six content/MLP kernels only)
+        ("pos_proj_kernel", r"layers/(pos_q|pos_k)/kernel", P(None, None, "tp")),
+        ("pos_proj_bias", r"layers/(pos_q|pos_k)/bias", P(None, "tp")),
+        ("head_dense_kernel", r"head_dense/kernel", P(None, "tp")),
+        ("head_dense_bias", r"head_dense/bias", P("tp")),
+        ("head_out_kernel", r"head_out/kernel", P("tp", None)),
+        ("head_out_bias", r"head_out/bias", P(None)),
+    ) + _encoder_rules(quantized=quantized)
+
+
+def partition_rules_for(arch: str, quantized: bool = False) -> tuple:
+    if arch == "bert":
+        return bert_partition_rules(quantized=quantized)
+    if arch == "deberta":
+        return deberta_partition_rules(quantized=quantized)
+    raise ValueError(f"no partition rules for arch {arch!r}")
+
+
+def tree_path_leaves(tree: dict) -> list:
+    """[(\"layers/attn_q/kernel\", leaf), ...] — "/"-joined string paths."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for key in path:
+            parts.append(str(getattr(key, "key", getattr(key, "idx", key))))
+        out.append(("/".join(parts), leaf))
+    return out
+
+
+def match_report(rules: tuple, tree: dict) -> tuple:
+    """Audit-grade matching: (leaf_matches, rule_counts) where
+    leaf_matches maps each leaf path to the LIST of matching rule names
+    (so callers can flag both uncovered and ambiguous leaves) and
+    rule_counts maps each rule name to its total match count (0 = dead
+    rule)."""
+    leaf_matches: dict = {}
+    rule_counts = {name: 0 for name, _, _ in rules}
+    for path, _leaf in tree_path_leaves(tree):
+        hits = [
+            name
+            for name, pattern, _spec in rules
+            if re.fullmatch(pattern, path)
+        ]
+        leaf_matches[path] = hits
+        for name in hits:
+            rule_counts[name] += 1
+    return leaf_matches, rule_counts
+
+
+def match_partition_rules(rules: tuple, tree: dict) -> dict:
+    """Param tree -> PartitionSpec tree via the rule table (the
+    match_partition_rules shape used by the big public jax LLM repos).
+    First matching rule wins; a leaf no rule matches is an error — the
+    rule table, not a silent replicate default, is the source of truth."""
+    specs = {name: spec for name, _, spec in rules}
+    compiled = [(name, pattern) for name, pattern, _ in rules]
+
+    leaves = tree_path_leaves(tree)
+    spec_by_path = {}
+    for path, _leaf in leaves:
+        for name, pattern in compiled:
+            if re.fullmatch(pattern, path):
+                spec_by_path[path] = specs[name]
+                break
+        else:
+            raise ValueError(f"no partition rule matches param leaf {path!r}")
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out_leaves = []
+    for path, _leaf in flat:
+        parts = "/".join(
+            str(getattr(key, "key", getattr(key, "idx", key))) for key in path
+        )
+        out_leaves.append(spec_by_path[parts])
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def strip_tp(spec_tree):
+    """Replace the "tp" axis with None everywhere (tp=1 / TP-off layout)."""
+    return jax.tree_util.tree_map(
+        lambda spec: P(*(None if axis == "tp" else axis for axis in spec)),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def shard_by_rules(
+    params: dict, mesh: Mesh, rules: tuple, tp: bool = True
+) -> dict:
+    """Place a param pytree on the mesh per the rule table."""
+    specs = match_partition_rules(rules, params)
+    if not (tp and mesh.shape.get("tp", 1) > 1):
+        specs = strip_tp(specs)
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         params,
         specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def shard_bert_params(params: dict, mesh: Mesh, tp: bool = True) -> dict:
+    """Place a bert param pytree on the mesh with the TP layout."""
+    from ..models.quant import is_quantized
+
+    rules = bert_partition_rules(quantized=is_quantized(params))
+    return shard_by_rules(params, mesh, rules, tp=tp)
 
 
 def shard_embedder(embedder, mesh: Mesh, tp: bool = False) -> None:

@@ -282,6 +282,16 @@ def enable_compile_cache(path: str) -> None:
     # has a handful of bucketed shapes and all of them matter cold
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax latches the cache-disabled decision at the process's FIRST
+    # compile; enabling the dir afterwards is a silent no-op unless the
+    # latch is reset.  Internal API, so fail open: worst case is the
+    # pre-reset behavior (no persistent cache) rather than no serving.
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
 
 
 def _parse_warmup(raw) -> list:

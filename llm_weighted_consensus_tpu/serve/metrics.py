@@ -15,6 +15,23 @@ from collections import deque
 
 _RESERVOIR = 1024  # recent samples kept per series
 
+# Every provider-section name that may appear in the /metrics snapshot.
+# The registry the LWC010 lint checks both ways: a `register_provider`
+# call with a name not listed here fails lint (dashboards/tests grep
+# these keys, so ad-hoc names silently vanish from alerting), and a
+# listed name no call site registers is a stale entry to delete.
+KNOWN_SECTIONS = (
+    "resilience",
+    "admission",
+    "device_watchdog",
+    "lifecycle",
+    "device_batcher",
+    "embed_cache",
+    "score_cache",
+    "traces",
+    "jit",
+)
+
 
 class Metrics:
     def __init__(self) -> None:

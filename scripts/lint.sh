@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # Lint entry point: generic lint (ruff, if installed — config pinned in
 # pyproject.toml) + the first-party invariant checker (AST rules +
-# jaxpr serving-path audit).  Run from anywhere; extra args pass
-# through to the checker (e.g. scripts/lint.sh --no-jaxpr file.py).
+# jaxpr serving-path audit + simulated-mesh sharding/resource audit).
+# Run from anywhere; extra args pass through to the checker (e.g.
+# scripts/lint.sh --no-jaxpr --no-mesh file.py; ANALYSIS_SKIP_MESH=1
+# also skips the mesh audit).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,17 @@
+"""LWC008 violating fixture: env reads scattered outside the config
+door — knobs tests can't inject and the README never lists."""
+
+import os
+
+
+def pick_timeout():
+    return float(os.environ.get("FIXTURE_TIMEOUT_MS", "100"))
+
+
+def pick_retries():
+    return int(os.getenv("FIXTURE_RETRIES", "3"))
+
+
+class Worker:
+    def concurrency(self):
+        return int(os.environ["FIXTURE_CONCURRENCY"])

@@ -1,0 +1,31 @@
+"""LWC008 conforming fixture: knobs enter through a ``from_env(env)``
+boundary that takes the environment as a plain injectable dict."""
+
+
+class Settings:
+    def __init__(self, timeout_ms, retries):
+        self.timeout_ms = timeout_ms
+        self.retries = retries
+
+    @classmethod
+    def from_env(cls, env):
+        return cls(
+            timeout_ms=float(env.get("TIMEOUT", "100")),
+            retries=int(env.get("RETRIES", "3")),
+        )
+
+
+def pick_timeout(settings):
+    return settings.timeout_ms
+
+
+def interlock_enabled():
+    """Exempt namespaces: LWC_* interlocks and FAKE_UPSTREAM_* harness
+    knobs are deliberately read from the literal process environment."""
+    import os
+
+    if os.environ.get("LWC_FIXTURE_INTERLOCK", ""):
+        return True
+    if os.getenv("LWC_FIXTURE_NATIVE", "1") == "0":
+        return False
+    return bool(os.environ["FAKE_UPSTREAM_FIXTURE_DELAY_MS"])

@@ -1,0 +1,10 @@
+"""LWC009 violating fixture: device work called directly inside
+coroutines — dispatch (or a surprise compile) blocks the event loop."""
+
+import jax
+import jax.numpy as jnp
+
+
+async def embed(batch):
+    vecs = jnp.asarray(batch)
+    return jax.device_get(vecs)

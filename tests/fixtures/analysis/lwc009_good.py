@@ -1,0 +1,13 @@
+"""LWC009 conforming fixture: coroutines hand device work to a sync
+helper on the executor — the batcher/embedder boundary pattern."""
+
+import jax.numpy as jnp
+
+
+def _forward(batch):
+    # sync helper: runs on the executor thread, never on the event loop
+    return jnp.asarray(batch)
+
+
+async def embed(loop, batch):
+    return await loop.run_in_executor(None, _forward, batch)

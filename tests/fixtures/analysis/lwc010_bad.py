@@ -1,0 +1,16 @@
+"""LWC010 violating fixture: registries out of sync with their call
+sites in both directions — an undeclared metric section, a dead
+registry row, and an undeclared span name."""
+
+KNOWN_SECTIONS = ("alpha", "dead_row")
+KNOWN_SPANS = ("work:*",)
+
+
+def wire(metrics, item):
+    metrics.register_provider("alpha", dict)
+    metrics.register_provider("ghost", dict)
+
+
+def trace(child_span, item):
+    child_span("work:step")
+    child_span(f"rogue:{item}")

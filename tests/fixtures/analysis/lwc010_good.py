@@ -1,0 +1,14 @@
+"""LWC010 conforming fixture: every registry row has a call site and
+every call site uses a declared name."""
+
+KNOWN_SECTIONS = ("alpha",)
+KNOWN_SPANS = ("work:*", "flush")
+
+
+def wire(metrics):
+    metrics.register_provider("alpha", dict)
+
+
+def trace(child_span, item):
+    child_span(f"work:{item}")
+    child_span("flush")

@@ -1,0 +1,12 @@
+"""LWC011 violating fixture: a ``from_env`` knob the sibling README
+never documents, next to a README entry no module reads anymore
+(the README lives at tests/fixtures/analysis/README.md)."""
+
+
+class Settings:
+    def __init__(self, limit):
+        self.limit = limit
+
+    @classmethod
+    def from_env(cls, env):
+        return cls(limit=int(env.get("FIXKNOB_UNDOCUMENTED", "8")))

@@ -1,0 +1,12 @@
+"""LWC011 conforming fixture: the one knob ``from_env`` reads is
+documented in the sibling README, and every README token of a family
+this module owns is really read."""
+
+
+class Settings:
+    def __init__(self, limit):
+        self.limit = limit
+
+    @classmethod
+    def from_env(cls, env):
+        return cls(limit=int(env.get("FIXGOOD_KNOB_ONE", "8")))

@@ -43,6 +43,10 @@ EXPECTED_BAD = {
     "LWC005": 3,  # BinOp + AugAssign + Decimal(float)
     "LWC006": 2,  # time.sleep + open
     "LWC007": 2,  # message() + wire envelope
+    "LWC008": 3,  # environ.get + getenv + environ[...] subscript
+    "LWC009": 2,  # jnp call + jax call inside one coroutine
+    "LWC010": 3,  # undeclared section + dead registry row + rogue span
+    "LWC011": 2,  # undocumented from_env knob + stale README token
 }
 
 
@@ -138,8 +142,14 @@ def test_package_lints_clean_against_baseline():
 def test_cli_exit_codes(tmp_path, capsys):
     from llm_weighted_consensus_tpu.analysis.__main__ import main
 
-    assert main([str(FIXTURES / "lwc002_good.py"), "--no-jaxpr"]) == 0
-    rc = main([str(FIXTURES / "lwc002_bad.py"), "--no-jaxpr"])
+    # single-file lint: the package-wide suppressions don't apply (and
+    # would read stale), so scope the run to an empty baseline; the
+    # jaxpr/mesh audits are package-level, not per-file — skip them
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"suppressions": []}))
+    base = ["--no-jaxpr", "--no-mesh", "--baseline", str(empty)]
+    assert main([str(FIXTURES / "lwc002_good.py"), *base]) == 0
+    rc = main([str(FIXTURES / "lwc002_bad.py"), *base])
     assert rc == 1
     assert "LWC002" in capsys.readouterr().out
 
@@ -163,6 +173,7 @@ def test_cli_exit_codes(tmp_path, capsys):
             [
                 str(FIXTURES / "lwc002_good.py"),
                 "--no-jaxpr",
+                "--no-mesh",
                 "--baseline",
                 str(stale),
             ]
@@ -276,3 +287,189 @@ def test_audit_flags_missing_pallas_kernel():
     )
     assert [f.rule for f in findings] == ["JXA002"]
     assert "pallas" in findings[0].message
+
+
+# -- mesh audit (JXA006-011) -------------------------------------------------
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from llm_weighted_consensus_tpu.analysis.budgets import (  # noqa: E402
+    check_allowlist_stale,
+    compare_budgets,
+)
+from llm_weighted_consensus_tpu.analysis.mesh_audit import (  # noqa: E402
+    audit_hlo_collectives,
+    audit_replication,
+    audit_rule_coverage,
+    run_mesh_audit,
+)
+
+_TOY_TREE = {
+    "embed": SDS((512, 1024), jnp.float32),  # 2 MiB: above threshold
+    "layers": {"kernel": SDS((8, 8), jnp.float32)},
+}
+_TOY_RULES = (
+    ("embed", r"embed", P()),
+    ("kernel", r"layers/kernel", P(None, "tp")),
+)
+
+
+def test_mesh_audit_serving_path_clean():
+    """The acceptance: coverage, replication policy, collective plan,
+    committed budgets, and sharded-vs-single-device equivalence of every
+    serving bucket on the simulated mesh — zero findings."""
+    findings = run_mesh_audit()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_coverage_clean_on_toy_tree():
+    assert audit_rule_coverage(_TOY_RULES, _TOY_TREE, "toy") == []
+
+
+def test_coverage_flags_uncovered_param_leaf():
+    """Injected regression: a param leaf no rule matches is JXA006."""
+    rules = (_TOY_RULES[0],)  # drop the kernel rule
+    findings = audit_rule_coverage(rules, _TOY_TREE, "toy")
+    assert [f.rule for f in findings] == ["JXA006"]
+    assert findings[0].symbol == "layers/kernel"
+    assert "NO partition rule" in findings[0].message
+
+
+def test_coverage_flags_unused_rule():
+    """Injected regression: a rule matching no leaf is JXA006 too."""
+    rules = _TOY_RULES + (("ghost", r"layers/nonexistent", P()),)
+    findings = audit_rule_coverage(rules, _TOY_TREE, "toy")
+    assert [f.rule for f in findings] == ["JXA006"]
+    assert findings[0].symbol == "ghost"
+    assert "no param leaf" in findings[0].message
+
+
+def test_coverage_flags_ambiguous_leaf():
+    rules = _TOY_RULES + (("dup", r"emb.*", P()),)
+    findings = audit_rule_coverage(rules, _TOY_TREE, "toy")
+    assert {f.rule for f in findings} == {"JXA006"}
+    assert any("ambiguous" in f.message for f in findings)
+
+
+def test_replication_flags_oversized_replicated_tensor():
+    """Injected regression: a >threshold leaf left fully replicated
+    without an allowlist entry is JXA007."""
+    findings, matched = audit_replication(
+        _TOY_RULES, _TOY_TREE, "toy", threshold_bytes=1 << 20, allowlist=[]
+    )
+    assert [f.rule for f in findings] == ["JXA007"]
+    assert findings[0].symbol == "embed"
+    assert matched == set()
+
+
+def test_replication_allowlist_and_stale_detection():
+    allow = [{"pattern": "embed", "reason": "gather beats all-to-all"}]
+    findings, matched = audit_replication(
+        _TOY_RULES, _TOY_TREE, "toy", threshold_bytes=1 << 20, allowlist=allow
+    )
+    assert findings == []
+    assert matched == {"embed"}
+    assert check_allowlist_stale(allow, matched) == []
+    # the allowlisted tensor got sharded/removed: the entry is stale
+    stale = check_allowlist_stale(allow, set())
+    assert [f.rule for f in stale] == ["JXA010"]
+    assert "stale replicated_allowlist" in stale[0].message
+
+
+_SHARDED_HLO = """\
+ENTRY %main { %ar = f32[8,16] all-reduce(f32[8,16] %x) }
+"""
+
+
+def test_hlo_collectives_clean():
+    assert audit_hlo_collectives(_SHARDED_HLO, "toy") == []
+
+
+def test_hlo_flags_missing_expected_collective():
+    """Injected regression: HLO without the TP reduction is JXA008 (the
+    layout degenerated to replication)."""
+    findings = audit_hlo_collectives(
+        "ENTRY %main { %d = f32[8,16] dot(%x, %w) }", "toy"
+    )
+    assert [f.rule for f in findings] == ["JXA008"]
+    assert "expected collective" in findings[0].message
+
+
+def test_hlo_flags_forbidden_collective():
+    """Injected regression: an all-to-all (or host transfer) in the hot
+    path is JXA008."""
+    findings = audit_hlo_collectives(
+        _SHARDED_HLO + "%a2a = f32[8,16] all-to-all(%x)\n", "toy"
+    )
+    assert [f.rule for f in findings] == ["JXA008"]
+    assert "all-to-all" in findings[0].message
+    findings = audit_hlo_collectives(
+        _SHARDED_HLO + "%s = f32[] send(%x), is_host_transfer=true\n", "toy"
+    )
+    assert [f.rule for f in findings] == ["JXA008"]
+
+
+_BUDGETS = {
+    "scope": {"model": "toy"},
+    "tolerance": {"hbm_bytes": 0.25, "flops": 0.25, "bytes_accessed": 0.25},
+    "buckets": {
+        "vote1(n=8,s=16)": {
+            "hbm_bytes": 1000.0,
+            "flops": 2000.0,
+            "bytes_accessed": 3000.0,
+        }
+    },
+}
+_IN_BAND = {
+    "vote1(n=8,s=16)": {
+        "hbm_bytes": 1100.0,
+        "flops": 2100.0,
+        "bytes_accessed": 2900.0,
+    }
+}
+
+
+def test_budgets_in_band_clean():
+    assert (
+        compare_budgets(_IN_BAND, _BUDGETS, scope={"model": "toy"}) == []
+    )
+
+
+def test_budgets_flag_breach():
+    """Injected regression: a measured figure past the tolerance band is
+    JXA009, naming the bucket and metric."""
+    over = {
+        "vote1(n=8,s=16)": {
+            "hbm_bytes": 1500.0,  # 1.5x vs ±25%
+            "flops": 2000.0,
+            "bytes_accessed": 3000.0,
+        }
+    }
+    findings = compare_budgets(over, _BUDGETS, scope={"model": "toy"})
+    assert [f.rule for f in findings] == ["JXA009"]
+    assert findings[0].symbol == "vote1(n=8,s=16)"
+    assert "hbm_bytes" in findings[0].message
+    assert "outgrew" in findings[0].message
+
+
+def test_budgets_flag_missing_and_stale_entries():
+    """Injected regressions: an audited bucket with no committed entry,
+    and a committed bucket the audit no longer lowers — both JXA010."""
+    measured = dict(_IN_BAND)
+    measured["packed(b=8,l=64,k=8)"] = {"hbm_bytes": 1.0}
+    findings = compare_budgets(measured, _BUDGETS, scope={"model": "toy"})
+    assert [f.rule for f in findings] == ["JXA010"]
+    assert "no committed budget" in findings[0].message
+
+    findings = compare_budgets({}, _BUDGETS, scope={"model": "toy"})
+    assert [f.rule for f in findings] == ["JXA010"]
+    assert "stale budget entry" in findings[0].message
+
+
+def test_budgets_flag_scope_mismatch_and_missing_file():
+    findings = compare_budgets(_IN_BAND, _BUDGETS, scope={"model": "other"})
+    assert [f.rule for f in findings] == ["JXA010"]
+    assert "scope" in findings[0].message
+    findings = compare_budgets(_IN_BAND, {}, scope={"model": "toy"})
+    assert [f.rule for f in findings] == ["JXA010"]
+    assert "--write-budgets" in findings[0].message

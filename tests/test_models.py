@@ -491,5 +491,14 @@ def test_bf16_reranker_preserves_reward_ordering():
     cf, tf = full.rerank_confidence(texts, prompt="what is 2+2?")
     cb, tb = bf16.rerank_confidence(texts, prompt="what is 2+2?")
     assert tf == tb
-    assert list(np.argsort(cf)) == list(np.argsort(cb)), (cf, cb)
+    # Order is only observable above bf16 resolution: random-init rewards
+    # can land within ~1e-5 of each other, where bf16's ~3 decimal digits
+    # legitimately tie.  Assert pairwise order for every pair the f32
+    # path itself separates beyond bf16 noise, instead of a full argsort
+    # (which would flip on those ties and fail spuriously).
+    sep = 5e-3
+    for i in range(len(texts)):
+        for j in range(len(texts)):
+            if cf[i] - cf[j] > sep:
+                assert cb[i] > cb[j], (i, j, cf, cb)
     assert np.abs(cf - cb).max() < 0.05, (cf, cb)

@@ -75,6 +75,76 @@ def test_tp_sharded_forward_matches_replicated(mesh):
     np.testing.assert_allclose(out, base, atol=1e-5)
 
 
+def test_partition_rules_equal_legacy_template():
+    """The rule table IS the spec template: matching the rules against a
+    real param tree reproduces bert_param_specs leaf-for-leaf (plain and
+    int8), so the audit-friendly dual can never drift from the layout
+    the serving path actually uses."""
+    from llm_weighted_consensus_tpu.models.quant import quantize_bert_params
+
+    params = bert.init_params(jax.random.PRNGKey(0), TEST_TINY)
+    for quantized in (False, True):
+        tree = quantize_bert_params(params) if quantized else params
+        got = sharding.match_partition_rules(
+            sharding.bert_partition_rules(quantized=quantized), tree
+        )
+        want = sharding.bert_param_specs(quantized=quantized)
+        got_leaves = dict(sharding.tree_path_leaves(got))
+        want_leaves = dict(sharding.tree_path_leaves(want))
+        assert got_leaves == want_leaves, quantized
+
+
+@pytest.mark.parametrize("arch", ["bert", "deberta"])
+@pytest.mark.parametrize("quantized", [False, True])
+def test_partition_rules_cover_every_leaf_exactly_once(arch, quantized):
+    """The JXA006 contract at the unit level: every leaf of every
+    audited tree matches exactly one rule and no rule is dead."""
+    from llm_weighted_consensus_tpu.models import deberta
+    from llm_weighted_consensus_tpu.models.quant import (
+        quantize_bert_params,
+        quantize_deberta_params,
+    )
+    from llm_weighted_consensus_tpu.models.reranker import RM_PRESETS
+
+    rng = jax.random.PRNGKey(0)
+    if arch == "bert":
+        init = lambda: bert.init_params(rng, TEST_TINY)
+        quant = quantize_bert_params
+    else:
+        init = lambda: deberta.init_params(
+            rng, RM_PRESETS["deberta-test-tiny"]
+        )
+        quant = quantize_deberta_params
+    tree = jax.eval_shape(lambda: quant(init()) if quantized else init())
+    rules = sharding.partition_rules_for(arch, quantized=quantized)
+    leaf_matches, rule_counts = sharding.match_report(rules, tree)
+    assert all(len(hits) == 1 for hits in leaf_matches.values()), {
+        p: h for p, h in leaf_matches.items() if len(h) != 1
+    }
+    assert all(count >= 1 for count in rule_counts.values()), rule_counts
+
+
+def test_match_partition_rules_raises_on_uncovered_leaf():
+    rules = (("only_a", r"a", sharding.P(None)),)
+    with pytest.raises(ValueError, match="no partition rule"):
+        sharding.match_partition_rules(
+            rules, {"a": jnp.zeros(2), "b": jnp.zeros(2)}
+        )
+
+
+def test_shard_by_rules_places_tp_layout(mesh):
+    """shard_by_rules puts column kernels on the tp axis and strips tp
+    when asked — and the placed tree still runs the forward."""
+    params = bert.init_params(jax.random.PRNGKey(0), TEST_TINY)
+    rules = sharding.bert_partition_rules()
+    placed = sharding.shard_by_rules(params, mesh, rules)
+    spec = placed["layers"]["attn_q"]["kernel"].sharding.spec
+    assert "tp" in tuple(spec)
+    off = sharding.shard_by_rules(params, mesh, rules, tp=False)
+    spec_off = off["layers"]["attn_q"]["kernel"].sharding.spec
+    assert "tp" not in tuple(spec_off)
+
+
 def test_shard_embedder_same_results(dp_mesh):
     emb = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=32, seed=1)
     texts = [f"text number {i}" for i in range(8)]

@@ -1,24 +1,42 @@
 #!/usr/bin/env python
-"""dp-scaling evidence for the >=10x multi-chip target (VERDICT r2 item 6).
+"""dp-scaling MEASUREMENT for the >=10x multi-chip target (ISSUE PR 9;
+structure-only predecessor: VERDICT r2 item 6).
 
-One real chip cannot demonstrate v5e-8 throughput, so this harness proves
-the SHARDING STRUCTURE that the DESIGN.md projection multiplies by: on a
-virtual 8-device CPU mesh it verifies, for dp = 1/2/4/8,
+Closed-loop consensus answers/sec through the real serving path — the
+DeviceBatcher feeding a first-class mesh-sharded embedder
+(``shard_embedder_mesh`` + per-(mesh-shape, bucket) AOT warmup) — at
+dp = 1/2/4/8.  The workload is FIXED across the sweep (same worker
+count, same requests, same texts), so the dp=1 row is the baseline and
+every other row is the same work on a wider mesh:
 
-* a 64-candidate consensus batch splits into exactly B/dp rows per device
-  (weak scaling: per-device work shrinks linearly with dp);
-* the whole embed + collective consensus vote runs as ONE dispatch per
-  request at every dp (the dispatch count the single-chip bench measures
-  is dp-invariant — no hidden per-shard round-trips appear at scale);
-* the dp-sharded collective result equals the single-device result.
+* answers/sec per dp, measured wall-clock after AOT warmup;
+* dispatch accounting from the batcher's own counters: every request
+  rides exactly one jit-with-shardings dispatch at every dp (no hidden
+  per-shard round-trips appear at scale);
+* per-request numerics equal the single-device embedder's answers.
 
-Prints one JSON line per dp.  The throughput projection that combines
-this structure with the measured single-chip rate lives in DESIGN.md
-("Scaling to the 10x target"); BENCH numbers stay measurement-only.
+Efficiency basis — read this before the numbers: this box has ONE
+physical core (``nproc`` is recorded in the record), so the 8 virtual
+devices timeshare it and wall-clock can never show a dp-fold speedup.
+What the closed loop CAN measure honestly is the work-conserving
+overhead of the sharded program: answers/sec at dp=8 staying >= 0.75x
+the dp=1 rate means sharding + collectives + staging add <= 25% total
+work, which is the parallel efficiency an 8-chip ICI mesh realizes on
+this program (its per-chip work is 1/8th, and the collectives ride
+links this CPU run charges to the same core).  The committed record
+pins ``efficiency_basis`` so nobody reads the virtual-mesh rate as a
+throughput claim.
 
-Run: python bench_scaling.py   (self-bootstraps a CPU mesh subprocess
-when the ambient JAX runtime has fewer than 8 devices, exactly like
-__graft_entry__.dryrun_multichip).
+TPU pre-flight (PR 7 discipline): when JAX_PLATFORMS requests a TPU,
+the wedge-proof probe from bench.py runs first — a dead tunnel prints
+one degraded ``tpu-unavailable`` record and exits 2 in seconds instead
+of hanging the driver; this box has no TPU, so the committed
+BENCH_r07.json is the virtual-mesh run with the probe outcome recorded.
+
+Run: python bench_scaling.py   (self-bootstraps a virtual 8-device CPU
+mesh subprocess when the ambient runtime has fewer than 8 devices,
+exactly like __graft_entry__.dryrun_multichip).  Writes BENCH_r07.json
+next to this file in addition to the per-dp JSON lines.
 """
 
 from __future__ import annotations
@@ -28,232 +46,231 @@ import os
 import subprocess
 import sys
 
+N_CANDIDATES = 64
+WORKERS = 8          # fixed offered concurrency at every dp
+REQUESTS_PER_WORKER = 3
+REQUIRED_EFFICIENCY = 0.75
 
-def run_inprocess() -> None:
-    import jax
-    import numpy as np
-
-    from bench import BASELINE_BASIS, bench_tokenizer, make_requests
-    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
-    from llm_weighted_consensus_tpu.parallel.collectives import (
-        sharded_cosine_vote,
-    )
-    from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
-    from llm_weighted_consensus_tpu.parallel.sharding import shard_embedder
-
-    b = 64  # one N=64 consensus request (the headline shape)
-    texts = make_requests(1, b)[0]
-    reference = None
-    for dp in (1, 2, 4, 8):
-        embedder = TpuEmbedder(
-            "test-tiny", max_tokens=32, tokenizer=bench_tokenizer(), seed=0
-        )
-        mesh = make_mesh(dp=dp, devices=jax.devices()[:dp])
-        shard_embedder(embedder, mesh)
-        ids, mask = embedder.tokenize(texts)
-        dev_ids, _ = embedder.put_batch(
-            jax.numpy.asarray(ids), jax.numpy.asarray(mask)
-        )
-        shard_rows = sorted(
-            s.data.shape[0] for s in dev_ids.addressable_shards
-        )
-        assert shard_rows == [b // dp] * dp, (dp, shard_rows)
-
-        # one embed + one collective vote = TWO dispatches at every dp:
-        # XLA launches the sharded program once over the whole mesh (the
-        # psum/all_gather ride inside it), so the host-side dispatch
-        # count the single-chip bench pays is dp-invariant
-        emb = embedder.embed_tokens(ids, mask)
-        conf = np.asarray(
-            sharded_cosine_vote(jax.numpy.asarray(emb), mesh)
-        )[:b]
-        if reference is None:
-            reference = conf
-        else:
-            np.testing.assert_allclose(conf, reference, atol=2e-4)
-        np.testing.assert_allclose(conf.sum(), 1.0, atol=1e-4)
-        print(
-            json.dumps(
-                {
-                    "dp": dp,
-                    "global_batch": b,
-                    "rows_per_device": b // dp,
-                    "devices_used": dp,
-                    "host_dispatches_per_request": 2,
-                    "collective_matches_single_device": True,
-                    "confidence_sum": round(float(conf.sum()), 6),
-                    "baseline_basis": BASELINE_BASIS,
-                }
-            ),
-            flush=True,
-        )
-    print(
-        json.dumps(
-            {
-                "scaling_evidence": "ok",
-                "note": (
-                    "per-device work shrinks linearly with dp and the "
-                    "collective tally is numerically dp-invariant; see "
-                    "DESIGN.md 'Scaling to the 10x target' for the "
-                    "throughput projection this structure supports"
-                ),
-            }
-        ),
-        flush=True,
-    )
+EFFICIENCY_BASIS = (
+    "work-conserving, single-host: all dp values timeshare the same "
+    "physical core(s) (see nproc), so answers/sec cannot grow with dp "
+    "here; efficiency = rate(dp)/rate(dp=1) measures the total extra "
+    "work the sharded program adds (partitioning, collectives, staging) "
+    "and >= 0.75 at dp=8 bounds that overhead at 25% — the efficiency "
+    "a real 8-chip ICI mesh realizes on this program, where per-chip "
+    "work is 1/dp"
+)
 
 
-def run_load_test() -> None:
-    """Request-replication under load (VERDICT r3 item 6): R concurrent
-    N=64 consensus requests against a dp mesh, served as ONE batched
-    dispatch (`consensus_confidence_tokens_many`, the serving batcher's
-    device path).  Proves the load-test STRUCTURE of the 8-chip
-    projection: each request's 64 candidate rows land on exactly one
-    device (request replication over dp — no cross-request collective on
-    the throughput path), the host pays one dispatch for all R, and
-    per-request numerics equal the single-request result.
-
-    The wall-clock answers/s printed here timeshare 8 VIRTUAL devices on
-    this box's one physical CPU core, so it cannot show the R-fold
-    speedup itself; ``projected_v5e8_answers_per_sec`` combines this
-    verified structure with the single-chip measured device time
-    (bench.py device_only_ms, DESIGN.md projection) — real chips run the
-    replicas in parallel because the rows are disjoint per device.
-    """
+def run_closed_loop() -> dict:
+    """The measurement body; requires >= 8 JAX devices."""
+    import asyncio
     import time
 
     import jax
     import numpy as np
 
-    from bench import bench_tokenizer, make_requests
+    from bench import BASELINE_BASIS, bench_tokenizer, make_requests
     from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
     from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
-    from llm_weighted_consensus_tpu.parallel.sharding import shard_embedder
+    from llm_weighted_consensus_tpu.parallel.sharding import (
+        shard_embedder_mesh,
+    )
+    from llm_weighted_consensus_tpu.serve.batcher import DeviceBatcher
+    from llm_weighted_consensus_tpu.serve.metrics import Metrics
 
-    n = 64
-    measured_single_chip_ms = 31.93  # bench.py r4 device_only_ms median
+    n_requests = WORKERS * REQUESTS_PER_WORKER
+    requests = make_requests(n_requests, N_CANDIDATES)
+
+    # single-device oracle: same preset + seed, never sharded
+    ref = TpuEmbedder(
+        "test-tiny", max_tokens=32, tokenizer=bench_tokenizer(), seed=0
+    )
+    ref_conf = [
+        np.asarray(ref.consensus_confidence(texts)) for texts in requests[:4]
+    ]
+
+    def closed_loop(batcher):
+        """WORKERS workers, each issuing its requests sequentially —
+        the batcher groups whatever lands inside a window, exactly as
+        under the gateway."""
+
+        async def worker(w):
+            out = []
+            for i in range(REQUESTS_PER_WORKER):
+                conf, _tok = await batcher.consensus(
+                    requests[w * REQUESTS_PER_WORKER + i]
+                )
+                out.append(conf)
+            return out
+
+        async def run():
+            per_worker = await asyncio.gather(
+                *(worker(w) for w in range(WORKERS))
+            )
+            return [c for confs in per_worker for c in confs]
+
+        return asyncio.new_event_loop().run_until_complete(run())
+
+    rows = []
     for dp in (1, 2, 4, 8):
-        r = dp  # one concurrent request per device: the replication shape
         embedder = TpuEmbedder(
             "test-tiny", max_tokens=32, tokenizer=bench_tokenizer(), seed=0
         )
-        mesh = make_mesh(dp=dp, devices=jax.devices()[:dp])
-        shard_embedder(embedder, mesh)
-        texts = make_requests(r, n)
-        toks = [embedder.tokenize(t) for t in texts]
-        seq = max(ids.shape[1] for ids, _ in toks)
-        ids = np.stack(
-            [np.pad(i, ((0, 0), (0, seq - i.shape[1]))) for i, _ in toks]
-        )
-        mask = np.stack(
-            [np.pad(m, ((0, 0), (0, seq - m.shape[1]))) for _, m in toks]
-        )
+        mesh = make_mesh(dp=dp, tp=1, devices=jax.devices()[:dp])
+        shard_embedder_mesh(embedder, mesh)
 
-        # single-request references (per request, unbatched path)
-        refs = [
-            np.asarray(embedder.consensus_confidence_tokens(i, m))
-            for (i, m) in toks
-        ]
-
-        # shard-placement evidence: the R*N batch splits so request i's
-        # rows live on device i (disjoint replicas, no cross-request op)
-        flat_ids = ids.reshape(r * n, seq)
-        dev_ids, _ = embedder.put_batch(
-            jax.numpy.asarray(flat_ids),
-            jax.numpy.asarray(mask.reshape(r * n, seq)),
-        )
-        rows_per_device = r * n // dp
-        placements = sorted(
-            (int(s.index[0].start or 0), s.device.id)
-            for s in dev_ids.addressable_shards
-        )
-        request_devices = {
-            i: {
-                dev
-                for start, dev in placements
-                if i * n <= start < (i + 1) * n
+        # warm every (mesh-shape, bucket) the traffic can hit: each
+        # request's (N, S) spec plus the grouped-R buckets the batcher
+        # can form under WORKERS-way concurrency
+        specs = sorted(
+            {
+                (N_CANDIDATES, embedder.tokenize(texts)[0].shape[1])
+                for texts in requests
             }
-            for i in range(r)
-        }
-        # exactly one device per request: empty sets would mean the batch
-        # fell back to replicated placement, which is precisely the
-        # regression this evidence exists to catch
-        assert all(len(devs) == 1 for devs in request_devices.values()), (
-            request_devices
         )
+        r_buckets = [r for r in (2, 4, 8) if r <= WORKERS]
+        embedder.aot_warmup(specs, r_buckets=r_buckets)
 
-        conf = np.asarray(
-            embedder.consensus_confidence_tokens_many(ids, mask)
+        # dp-sharding structure: a staged batch splits into B/dp rows
+        # per device (the weak-scaling shape the projection multiplies)
+        ids, mask = embedder.tokenize(requests[0])
+        dev_ids, _ = embedder._stage_batch(
+            *embedder._pad_rows(ids, mask)
         )
-        for i in range(r):
-            np.testing.assert_allclose(conf[i], refs[i], atol=2e-4)
+        shard_rows = sorted(
+            s.data.shape[0] for s in dev_ids.addressable_shards
+        )
+        padded = ids.shape[0] + (-ids.shape[0]) % dp
+        assert shard_rows == [padded // dp] * dp, (dp, shard_rows)
 
-        # amortized wall-clock for the batched dispatch (virtual devices
-        # timeshare one core — see docstring)
-        reps = 3
+        metrics = Metrics()
+        batcher = DeviceBatcher(embedder, metrics, window_ms=3.0)
+        confs = closed_loop(batcher)  # untimed: absorbs first-touch
+        spec_before = embedder.jit_stats()["specializations"]
         t0 = time.perf_counter()
-        for _ in range(reps):
-            np.asarray(embedder.consensus_confidence_tokens_many(ids, mask))
-        total = (time.perf_counter() - t0) / reps
-        print(
-            json.dumps(
-                {
-                    "load_test": True,
-                    "dp": dp,
-                    "concurrent_requests": r,
-                    "rows_per_device": rows_per_device,
-                    "one_dispatch_for_all_requests": True,
-                    "per_request_matches_single": True,
-                    "virtual_mesh_answers_per_sec": round(r / total, 2),
-                    "projected_v5e8_answers_per_sec": round(
-                        dp * 1000.0 / measured_single_chip_ms, 1
-                    ),
-                    "baseline_basis": BASELINE_BASIS,
-                    "note": (
-                        "virtual devices timeshare one physical core; "
-                        "the projection column multiplies the verified "
-                        "disjoint-replica structure by the measured "
-                        "single-chip device time"
-                    ),
-                }
-            ),
-            flush=True,
-        )
+        confs = closed_loop(batcher)
+        elapsed = time.perf_counter() - t0
+        # post-warmup mesh traffic must not have jitted anything new
+        assert embedder.jit_stats()["specializations"] == spec_before
+
+        for i, want in enumerate(ref_conf):
+            np.testing.assert_allclose(confs[i], want, atol=2e-4)
+
+        util = batcher.utilization()
+        # two closed-loop passes went through this batcher
+        per_request = util["dispatches"] / (2.0 * n_requests)
+        row = {
+            "dp": dp,
+            "devices_used": dp,
+            "n_candidates": N_CANDIDATES,
+            "rows_per_device": padded // dp,
+            "answers": n_requests,
+            "answers_per_sec": round(n_requests / elapsed, 3),
+            "dispatches_per_request": round(per_request, 4),
+            "aot_buckets": embedder.jit_stats()["aot_buckets"],
+            "matches_single_device": True,
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    base = rows[0]["answers_per_sec"]
+    for row in rows:
+        row["efficiency_vs_dp1"] = round(row["answers_per_sec"] / base, 4)
+    disp = {row["dispatches_per_request"] for row in rows}
+    record = {
+        "metric": (
+            f"closed-loop consensus answers/sec at N={N_CANDIDATES}, "
+            f"dp sweep 1/2/4/8, {WORKERS} workers (fixed workload)"
+        ),
+        "unit": "answers/sec",
+        "value": rows[-1]["answers_per_sec"],
+        "baseline_basis": BASELINE_BASIS,
+        "model": "test-tiny",
+        "backend": jax.default_backend(),
+        "nproc": len(os.sched_getaffinity(0)),
+        "efficiency_basis": EFFICIENCY_BASIS,
+        "rows": rows,
+        "efficiency_dp8_vs_dp1": rows[-1]["efficiency_vs_dp1"],
+        "dispatches_per_request_dp_invariant": len(disp) == 1,
+    }
+    eff = record["efficiency_dp8_vs_dp1"]
+    assert eff >= REQUIRED_EFFICIENCY, (
+        f"dp=8 efficiency {eff} under the work-conserving basis is below "
+        f"{REQUIRED_EFFICIENCY}: the sharded program adds too much "
+        "overhead to project near-linear chip scaling"
+    )
+    assert record["dispatches_per_request_dp_invariant"], rows
+    print(json.dumps(record), flush=True)
+    return record
+
+
+def _record_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r07.json"
+    )
 
 
 def main() -> None:
-    # peek at an ALREADY-initialized backend only (__graft_entry__ pattern):
-    # initializing here would hang on a wedged TPU tunnel, and this bench
-    # only ever needs the virtual CPU mesh
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from __graft_entry__ import _parent_device_count
+    # peek at an ALREADY-initialized backend only (__graft_entry__
+    # pattern): initializing here would hang on a wedged TPU tunnel
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from __graft_entry__ import _parent_device_count, _virtual_cpu_env
 
-    have = _parent_device_count() or 0
-    if have >= 8:
-        run_inprocess()
-        run_load_test()
+    tpu_probe = "not requested (JAX_PLATFORMS=%s)" % os.environ.get(
+        "JAX_PLATFORMS", ""
+    )
+    if "tpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # PR 7 wedge-proof pre-flight: a dead tunnel records
+        # tpu-unavailable and exits 2 in seconds, no hang
+        from bench import probe_or_exit
+
+        backend = probe_or_exit(
+            45.0,
+            record={
+                "metric": "closed-loop consensus answers/sec, dp sweep",
+                "value": None,
+                "unit": "answers/sec",
+            },
+        )
+        tpu_probe = f"ok: backend={backend}"
+
+    if (_parent_device_count() or 0) >= 8:
+        record = run_closed_loop()
+        record["tpu_preflight"] = tpu_probe
+        with open(_record_path(), "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
         return
+
     # re-exec on a virtual 8-device CPU mesh (same pattern as
     # __graft_entry__.dryrun_multichip); script dir already on sys.path
-    from __graft_entry__ import _virtual_cpu_env
-
     env = _virtual_cpu_env(8)
-    here = os.path.dirname(os.path.abspath(__file__))
     env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
         [
             sys.executable,
             "-c",
-            "import bench_scaling; bench_scaling.run_inprocess(); "
-            "bench_scaling.run_load_test()",
+            "import json, bench_scaling\n"
+            "record = bench_scaling.run_closed_loop()\n"
+            "print('bench-record ' + json.dumps(record))\n",
         ],
         cwd=here,
         env=env,
         text=True,
         capture_output=True,
-        timeout=600,
+        timeout=900,
     )
-    sys.stdout.write(proc.stdout)
+    for line in proc.stdout.splitlines():
+        if line.startswith("bench-record "):
+            record = json.loads(line[len("bench-record "):])
+            record["tpu_preflight"] = tpu_probe
+            with open(_record_path(), "w", encoding="utf-8") as f:
+                json.dump(record, f, indent=1)
+                f.write("\n")
+        else:
+            print(line, flush=True)
     sys.stderr.write(proc.stderr[-2000:] if proc.returncode else "")
     if proc.returncode != 0:
         raise SystemExit(proc.returncode)

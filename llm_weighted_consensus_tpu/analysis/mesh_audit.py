@@ -1,13 +1,17 @@
 """Mesh-aware sharding & resource audit of the serving path (JXA006–011).
 
 GSPMD sharding is propagated at trace time, which makes it *auditable*
-at trace time: this module lowers the serving entry points — the padded
-``_embed_and_vote`` / ``_embed_and_vote_many`` / ``bert.embed`` paths
-and the PR 7 packed ``bert.embed_packed`` / ``deberta.reward_packed``
-paths — under a simulated v5e-8 mesh (8 virtual CPU devices via
+at trace time: this module builds the first-class mesh embedder exactly
+as ``serve/__main__.py`` does (``shard_embedder_mesh`` + ``aot_warmup``)
+under a simulated v5e-8 mesh (8 virtual CPU devices via
 ``parallel/dist.py``'s ``--xla_force_host_platform_device_count``
-plumbing, dp=4 × tp=2 by default) and statically checks the partition
-plan, the collective plan, and the resource envelope before a single
+plumbing, dp=4 × tp=2 by default) and audits the ACTUAL serving
+executables in the embedder's AOT table — the same
+``jit``-with-shardings callables the batcher dispatches, not a parallel
+re-lowering that could drift from what serves traffic.  Only
+``deberta.reward_packed`` keeps a fresh lowering (the reranker has no
+AOT table; see ``_measure_reward_packed``).  Checked: the partition
+plan, the collective plan, and the resource envelope, before a single
 TPU chip is rented:
 
 * **JXA006 rule coverage** — against the first-class partition-rule
@@ -30,9 +34,12 @@ TPU chip is rented:
   flops / bytes-accessed (``cost_analysis``) compared against the
   committed ``analysis/budgets.json`` within a tolerance band; missing
   and stale entries fail too (``budgets.py``).
-* **JXA011 numerical equivalence** — each compiled sharded bucket runs
-  against the single-device eager reference on identical inputs;
-  results must agree to float32 reduction-reordering tolerance.
+* **JXA011 numerical equivalence** — each warmed bucket is driven
+  through the embedder's PUBLIC dispatch method against a same-seed
+  single-device reference embedder on identical inputs; results must
+  agree to float32 reduction-reordering tolerance, and a ``jit_stats``
+  bracket asserts the dispatches really rode the audited executables
+  (zero specialization growth).
 
 Device plumbing: the checks need ``dp*tp`` devices.  Under tier-1
 pytest the conftest already forces 8 virtual CPU devices, so everything
@@ -378,56 +385,75 @@ def audit_hlo_collectives(
 # ---------------------------------------------------------------------------
 
 
-def _measure_buckets(
-    model: str, dp: int, tp: int, specs, r_buckets, packed_buckets
-) -> Tuple[List[Finding], Dict[str, Dict[str, float]]]:
-    import jax
+def _packed_inputs(rng, vocab: int, b: int, l: int, k: int):
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from ..models import bert, deberta
-    from ..models.embedder import (
-        TpuEmbedder,
-        _bucket,
-        _embed_and_vote,
-        _embed_and_vote_many,
-        _seq_bucket,
-    )
-    from ..models.reranker import RM_PRESETS
-    from ..parallel.mesh import make_mesh
-    from ..parallel.sharding import (
-        bert_partition_rules,
-        deberta_partition_rules,
-        shard_by_rules,
-    )
+    pids = np.zeros((b, l), np.int32)
+    pseg = np.zeros((b, l), np.int32)
+    ppos = np.zeros((b, l), np.int32)
+    pstarts = np.zeros((b, k), np.int32)
+    for row in range(b):
+        n0, n1 = 5 + row % 3, 3
+        pids[row, : n0 + n1] = rng.integers(3, vocab, n0 + n1)
+        pseg[row, :n0] = 1
+        pseg[row, n0 : n0 + n1] = 2
+        ppos[row, :n0] = np.arange(n0)
+        ppos[row, n0 : n0 + n1] = np.arange(n1)
+        pstarts[row, 1] = n0
+    return pids, pseg, ppos, pstarts
+
+
+def audit_serving_executables(
+    embedder, ref, specs, r_buckets, packed_buckets
+) -> Tuple[List[Finding], Dict[str, Dict[str, float]]]:
+    """JXA008–011 against a warmed mesh embedder's AOT table — the very
+    ``jit``-with-shardings executables the batcher dispatches, not a
+    parallel re-lowering that could drift from what serves traffic.
+
+    Per bucket: JXA008/009/010 read the committed executable straight
+    out of ``embedder._aot`` (a missing bucket is itself a finding —
+    lazy jit at serve time breaks the zero-specialization contract).
+    JXA011 then drives the PUBLIC dispatch method end-to-end against a
+    same-seed single-device reference embedder, and the whole dispatch
+    block is bracketed by ``jit_stats`` snapshots: any specialization
+    growth means the dispatches bypassed the audited executables, which
+    would make the audit above vacuous — also a finding.
+
+    ``ref`` must be the same preset/seed left single-device; its
+    dispatches run BEFORE the snapshot bracket because the module-level
+    jit caches are shared across embedder instances.
+    """
+    import numpy as np
+
+    from ..models.embedder import _bucket, _seq_bucket
 
     findings: List[Finding] = []
     measured: Dict[str, Dict[str, float]] = {}
-    mesh = make_mesh(dp=dp, tp=tp)
-    # the audited layout is the sharded serving path: traced jnp vote
-    # (use_fused=False) at full precision — the fused Pallas kernel is
-    # single-device (interpret mode) and never runs under SPMD
-    embedder = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
-    params = embedder.params
-    params_s = shard_by_rules(params, mesh, bert_partition_rules())
-    batch_s = NamedSharding(mesh, P("dp", None))
-    repl_s = NamedSharding(mesh, P())
+    bm = embedder.batch_multiple
     rng = np.random.default_rng(0)
     vocab = embedder.config.vocab_size
-    temp = np.float32(1.0)
+    temp = 1.0
     atol = 1e-4
 
-    def put(arr, sharding):
-        return jax.device_put(arr, sharding)
-
-    def measure(label, fn, np_args, shardings, ref_out):
-        """Lower fn under the mesh, run JXA008/009/010 accounting and
-        the JXA011 sharded-vs-single-device comparison."""
-        jitted = jax.jit(fn)
-        args = [put(a, s) for a, s in zip(np_args, shardings)]
-        compiled = jitted.lower(params_s, *args).compile()
-        findings.extend(audit_hlo_collectives(compiled.as_text(), label))
-        mem = compiled.memory_analysis()
+    def account(label, key):
+        exe = embedder._aot.get(embedder._aot_key(key))
+        if exe is None:
+            findings.append(
+                Finding(
+                    rule="JXA008",
+                    path=f"mesh:{label}",
+                    line=0,
+                    message=(
+                        f"no AOT executable at serving bucket {key}: "
+                        "aot_warmup did not cover it, so mesh traffic at "
+                        "this bucket would lazily jit mid-request (the "
+                        "zero-specialization contract breaks)"
+                    ),
+                )
+            )
+            return
+        findings.extend(audit_hlo_collectives(exe.as_text(), label))
+        mem = exe.memory_analysis()
         figures = {
             "hbm_bytes": float(
                 mem.argument_size_in_bytes
@@ -435,21 +461,23 @@ def _measure_buckets(
                 + mem.temp_size_in_bytes
             ),
         }
-        cost = compiled.cost_analysis()
+        cost = exe.cost_analysis()
         cost0 = cost[0] if isinstance(cost, (list, tuple)) else cost
         figures["flops"] = float(cost0.get("flops", 0.0))
         figures["bytes_accessed"] = float(cost0.get("bytes accessed", 0.0))
         measured[label] = figures
-        sharded_out = np.asarray(compiled(params_s, *args))
-        if not np.allclose(sharded_out, ref_out, atol=atol, rtol=1e-4):
-            worst = float(np.max(np.abs(sharded_out - ref_out)))
+
+    def check(label, got, want):
+        got, want = np.asarray(got), np.asarray(want)
+        if not np.allclose(got, want, atol=atol, rtol=1e-4):
+            worst = float(np.max(np.abs(got - want)))
             findings.append(
                 Finding(
                     rule="JXA011",
                     path=f"mesh:{label}",
                     line=0,
                     message=(
-                        "sharded output diverges from the single-device "
+                        "mesh dispatch diverges from the single-device "
                         f"reference (max abs diff {worst:.2e} > {atol}): "
                         "the partition plan changed the math, not just "
                         "the layout"
@@ -457,98 +485,155 @@ def _measure_buckets(
                 )
             )
 
+    # Build every input and its single-device reference output FIRST:
+    # the reference dispatches specialize the SHARED module-level jit
+    # caches, and the zero-growth bracket below must see mesh traffic
+    # only.
+    cases = []  # (kind, label, aot bucket key, np inputs, ref output)
     for n, s in specs:
         s = _seq_bucket(s, embedder.max_tokens)
         ids = rng.integers(3, vocab, (n, s)).astype(np.int32)
         mask = np.ones((n, s), np.int32)
-
-        def vote1(p, i, m, t, _n=n):
-            return _embed_and_vote(
-                p, i, m, t, _n, embedder.config, embedder.pooling, False
-            )
-
-        ref = np.asarray(vote1(params, ids, mask, temp))
-        measure(
-            f"vote1(n={n},s={s})",
-            vote1,
-            (ids, mask, temp),
-            (batch_s, batch_s, repl_s),
-            ref,
+        ref_out = np.asarray(
+            ref.consensus_confidence_tokens(ids, mask, temperature=temp)
+        )
+        cases.append(
+            ("vote1", f"vote1(n={n},s={s})", ("vote1", n, s),
+             (ids, mask), ref_out)
         )
 
         pad_b = _bucket(n, embedder.MAX_DEVICE_BATCH)
+        pad_b += (-pad_b) % bm
         bids = rng.integers(3, vocab, (pad_b, s)).astype(np.int32)
         bmask = np.ones((pad_b, s), np.int32)
-
-        def embed_fn(p, i, m):
-            return bert.embed(
-                p, i, m, embedder.config,
-                pooling=embedder.pooling, normalize=True,
-            )
-
-        ref = np.asarray(embed_fn(params, bids, bmask))
-        measure(
-            f"embed(b={pad_b},s={s})",
-            embed_fn,
-            (bids, bmask),
-            (batch_s, batch_s),
-            ref,
+        ref_out = np.asarray(ref.embed_tokens(bids, bmask))
+        cases.append(
+            ("embed", f"embed(b={pad_b},s={s})", ("embed", pad_b, s),
+             (bids, bmask), ref_out)
         )
 
         for r in r_buckets:
             if r < 2:
                 continue
-            flat_ids = rng.integers(3, vocab, (r * n, s)).astype(np.int32)
-            flat_mask = np.ones((r * n, s), np.int32)
-
-            def many(p, i, m, t, _r=r, _n=n):
-                return _embed_and_vote_many(
-                    p, i, m, t, _r, _n, embedder.config, embedder.pooling
+            gids = rng.integers(3, vocab, (r, n, s)).astype(np.int32)
+            gmask = np.ones((r, n, s), np.int32)
+            ref_out = np.asarray(
+                ref.consensus_confidence_tokens_many(
+                    gids, gmask, temperature=temp
                 )
-
-            ref = np.asarray(many(params, flat_ids, flat_mask, temp))
-            measure(
-                f"many(r={r},n={n},s={s})",
-                many,
-                (flat_ids, flat_mask, temp),
-                (batch_s, batch_s, repl_s),
-                ref,
             )
-
-    def packed_inputs(b, l, k):
-        pids = np.zeros((b, l), np.int32)
-        pseg = np.zeros((b, l), np.int32)
-        ppos = np.zeros((b, l), np.int32)
-        pstarts = np.zeros((b, k), np.int32)
-        for row in range(b):
-            n0, n1 = 5 + row % 3, 3
-            pids[row, : n0 + n1] = rng.integers(3, vocab, n0 + n1)
-            pseg[row, :n0] = 1
-            pseg[row, n0 : n0 + n1] = 2
-            ppos[row, :n0] = np.arange(n0)
-            ppos[row, n0 : n0 + n1] = np.arange(n1)
-            pstarts[row, 1] = n0
-        return pids, pseg, ppos, pstarts
+            cases.append(
+                ("many", f"many(r={r},n={n},s={s})", ("many", r, n, s),
+                 (gids, gmask), ref_out)
+            )
 
     for b, l, k in packed_buckets:
-        pids, pseg, ppos, pstarts = packed_inputs(b, l, k)
-
-        def packed(p, i, g, pos, st):
-            return bert.embed_packed(
-                p, i, g, pos, st, embedder.config,
-                pooling=embedder.pooling, normalize=True,
-            )
-
-        ref = np.asarray(packed(params, pids, pseg, ppos, pstarts))
-        measure(
-            f"packed(b={b},l={l},k={k})",
-            packed,
-            (pids, pseg, ppos, pstarts),
-            (batch_s, batch_s, batch_s, batch_s),
-            ref,
+        pids, pseg, ppos, pstarts = _packed_inputs(rng, vocab, b, l, k)
+        ref_out = np.asarray(ref.embed_packed(pids, pseg, ppos, pstarts))
+        pb = b + (-b) % bm  # the dispatch pads rows to the dp multiple
+        cases.append(
+            ("packed", f"packed(b={pb},l={l},k={k})",
+             ("packed", pb, l, k), (pids, pseg, ppos, pstarts), ref_out)
         )
 
-    # the reward-model packed path, under the deberta rule table
+    before = embedder.jit_stats()["specializations"]
+    for kind, label, key, args, ref_out in cases:
+        account(label, key)
+        if kind == "vote1":
+            got = embedder.consensus_confidence_tokens(
+                args[0], args[1], temperature=temp
+            )
+        elif kind == "embed":
+            got = embedder.embed_tokens(*args)
+        elif kind == "many":
+            got = embedder.consensus_confidence_tokens_many(
+                args[0], args[1], temperature=temp
+            )
+        else:
+            got = embedder.embed_packed(*args)
+        check(label, got, ref_out)
+    after = embedder.jit_stats()["specializations"]
+    grew = {
+        name: f"{before.get(name, 0)}->{count}"
+        for name, count in after.items()
+        if count > before.get(name, 0)
+    }
+    if grew:
+        findings.append(
+            Finding(
+                rule="JXA008",
+                path="mesh:dispatch",
+                line=0,
+                message=(
+                    "mesh dispatches bypassed the audited AOT executables "
+                    f"and lazily jitted instead ({grew}): the bucket "
+                    "figures above describe executables that served no "
+                    "traffic"
+                ),
+            )
+        )
+    return findings, measured
+
+
+def _measure_buckets(
+    model: str, dp: int, tp: int, specs, r_buckets, packed_buckets
+) -> Tuple[List[Finding], Dict[str, Dict[str, float]]]:
+    """Build the first-class mesh embedder exactly as serve/__main__.py
+    does — ``shard_embedder_mesh`` + ``aot_warmup`` — then audit its AOT
+    table (``audit_serving_executables``) and the reward model's packed
+    lowering."""
+    import numpy as np
+
+    from ..models.embedder import TpuEmbedder
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import shard_embedder_mesh
+
+    mesh = make_mesh(dp=dp, tp=tp)
+    # the JXA011 oracle: same preset + seed, left single-device
+    ref = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
+    embedder = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
+    shard_embedder_mesh(embedder, mesh)
+    embedder.aot_warmup(
+        list(specs),
+        r_buckets=[r for r in r_buckets if r >= 2],
+        packed_buckets=list(packed_buckets),
+    )
+    findings, measured = audit_serving_executables(
+        embedder, ref, specs, r_buckets, packed_buckets
+    )
+    rm_findings, rm_measured = _measure_reward_packed(mesh, packed_buckets)
+    findings += rm_findings
+    measured.update(rm_measured)
+    return findings, measured
+
+
+def _measure_reward_packed(
+    mesh, packed_buckets
+) -> Tuple[List[Finding], Dict[str, Dict[str, float]]]:
+    """The reward-model packed path, under the deberta rule table.
+
+    Unlike the embedder buckets this IS a fresh lowering: the reranker
+    has no AOT table (serving jits ``deberta.reward_packed`` lazily),
+    so there is no committed executable to audit — the audit lowers the
+    same entry point the reranker's jit would, under the same rule-table
+    sharding."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import deberta
+    from ..models.reranker import RM_PRESETS
+    from ..parallel.sharding import deberta_partition_rules, shard_by_rules
+
+    findings: List[Finding] = []
+    measured: Dict[str, Dict[str, float]] = {}
+    batch_s = NamedSharding(mesh, P("dp", None))
+    rng = np.random.default_rng(0)
+    atol = 1e-4
+
+    def put(arr, sharding):
+        return jax.device_put(arr, sharding)
+
     rm_config = RM_PRESETS[_DEFAULT_RM_MODEL]
     rm_params = deberta.init_params(jax.random.PRNGKey(1), rm_config)
     rm_params_s = shard_by_rules(
@@ -556,8 +641,7 @@ def _measure_buckets(
     )
     rm_vocab = rm_config.vocab_size
     for b, l, k in packed_buckets:
-        pids, pseg, _ppos, pstarts = packed_inputs(b, l, k)
-        pids = np.minimum(pids, rm_vocab - 1)
+        pids, pseg, _ppos, pstarts = _packed_inputs(rng, rm_vocab, b, l, k)
 
         def reward_fn(p, i, g, st):
             return deberta.reward_packed(p, i, g, st, rm_config)

@@ -55,6 +55,30 @@ def _embed_and_vote(
 
 
 @partial(
+    jax.jit, static_argnames=("n", "config", "pooling", "mesh")
+)
+def _mesh_embed_and_vote(
+    params, ids, mask, temperature, n, config, pooling, mesh
+):
+    """Mesh-serving twin of ``_embed_and_vote``: encoder forward (params
+    Megatron-split over ``tp``, batch rows over ``dp`` via the input
+    shardings the caller staged) + the dp-sharded consensus reduction
+    (parallel/collectives.py) fused under ONE jit-with-shardings
+    dispatch, so embed and vote never round-trip the host and the vote's
+    all-gather/psum ride ICI.  Temperature is always TRACED here — the
+    fused Pallas vote is a single-device kernel (interpret-mode on CPU)
+    and never runs under SPMD — so user-supplied values cannot trigger
+    recompiles.  Rows at and past ``n`` are dp-alignment padding, masked
+    inside the sharded vote (``n_valid``) rather than sliced pre-vote: a
+    pre-vote slice would break the even dp row split."""
+    from ..parallel.collectives import sharded_cosine_vote
+
+    emb = bert.embed(params, ids, mask, config, pooling=pooling)
+    with jax.named_scope("consensus_vote"):
+        return sharded_cosine_vote(emb, mesh, temperature, n_valid=n)
+
+
+@partial(
     jax.jit, static_argnames=("r", "n", "config", "pooling")
 )
 def _embed_and_vote_many(
@@ -169,9 +193,11 @@ class TpuEmbedder:
     """A BGE-class encoder ready to embed batches on device.
 
     ``params=None`` random-inits (tests / no local checkpoint); pass a
-    pytree from ``bert.from_hf_weights`` for real bge weights.  ``shard``
-    (set by ``parallel.shard_embedder``) places params and batches on a
-    mesh; single-device otherwise.
+    pytree from ``bert.from_hf_weights`` for real bge weights.
+    ``parallel.shard_embedder_mesh`` flips the instance into first-class
+    mesh serving (params partitioned by the rule tables, per-(mesh-shape,
+    bucket) AOT executables); the legacy ``parallel.shard_embedder`` hook
+    path still works but forgoes AOT + packing; single-device otherwise.
     """
 
     def __init__(
@@ -234,19 +260,63 @@ class TpuEmbedder:
         self.embed_override = None
         # introspection: the sequence-parallel mesh when sp-sharded
         self.sp_mesh = None
+        # first-class mesh serving (parallel.shard_embedder_mesh /
+        # MESH_ENABLED): params placed by the partition-rule tables and
+        # dispatches staged with real input shardings.  Unlike the
+        # legacy put_batch hook above, mesh mode KEEPS the AOT fast path
+        # (executables lower with sharded avals, keyed per mesh shape)
+        # and the packed dispatch.
+        self.mesh_mode = False
+        self.mesh = None
+        self.mesh_shape = None
+        self.batch_sharding = None
+        self.repl_sharding = None
 
     # -- AOT bucket precompile ------------------------------------------------
 
     def _aot_ready(self) -> bool:
-        """Whether the AOT fast path is usable: single-device dispatch
-        only.  Mesh-sharded embedders (put_batch replaced, embed_override
-        set, or dp batch padding) bake shardings/shapes the plain-aval
-        lowering below doesn't carry — they keep the lazy-jit path."""
+        """Whether the AOT fast path is usable: single-device dispatch,
+        or the first-class mesh mode.  Mesh mode lowers with sharded
+        ShapeDtypeStructs, so its executables carry the input shardings
+        a plain-aval lowering doesn't.  The legacy hook paths (put_batch
+        replaced without mesh_mode, embed_override set, or a manual dp
+        batch_multiple) still keep the lazy-jit path."""
+        if self.mesh_mode:
+            return self.embed_override is None
         return (
             self.embed_override is None
             and getattr(self.put_batch, "_lwc_default", False)
             and self.batch_multiple == 1
         )
+
+    def _aot_key(self, key: tuple) -> tuple:
+        """AOT table key, namespaced per mesh shape in mesh mode: the
+        same bucket compiles to a DIFFERENT executable per (dp, tp) —
+        input shardings and the vote's collectives are baked in — so the
+        table holds per-(mesh-shape, bucket) entries that can never be
+        confused with single-device ones."""
+        if self.mesh_mode:
+            return ("mesh",) + tuple(self.mesh_shape) + key
+        return key
+
+    def _stage_batch(self, *arrays):
+        """Stage host int32 arrays for an AOT executable call: mesh mode
+        device_puts with the baked batch sharding (rows split over dp);
+        single-device is the plain transfer the executable expects."""
+        if self.mesh_mode:
+            return tuple(
+                jax.device_put(np.asarray(a), self.batch_sharding)
+                for a in arrays
+            )
+        return tuple(jnp.asarray(a) for a in arrays)
+
+    def _stage_temp(self, temperature):
+        """The vote temperature as a device scalar (replicated over the
+        mesh in mesh mode — the executable baked that sharding)."""
+        t = jnp.asarray(float(temperature), jnp.float32)
+        if self.mesh_mode:
+            t = jax.device_put(t, self.repl_sharding)
+        return t
 
     def _aot_lookup(self, key, ids, mask):
         if not self._aot or not self._aot_ready():
@@ -275,15 +345,26 @@ class TpuEmbedder:
         cache on jax 0.4.x, so caching the executables ourselves is what
         makes the warmup stick).  With ``COMPILE_CACHE_DIR`` set the
         lowering also lands in the persistent XLA cache, so restarts
-        deserialize instead of recompiling.  Returns [(label, seconds)]
-        for startup logging."""
+        deserialize instead of recompiling.
+
+        In mesh mode (``shard_embedder_mesh``) the same buckets lower
+        with SHARDED avals — batch rows split over ``dp``, params
+        already carrying their Megatron placement — producing one
+        executable per (mesh-shape, bucket) with the input shardings
+        and the vote's collectives baked in; see ``_aot_warmup_mesh``.
+
+        Returns [(label, seconds)] for startup logging."""
         import time as _time
 
         if not self._aot_ready():
             raise RuntimeError(
-                "AOT warmup needs the single-device embedder; mesh-sharded "
-                "embedders warm via real dispatches (serve/__main__.py)"
+                "AOT warmup needs the single-device embedder or the "
+                "first-class mesh mode (shard_embedder_mesh); legacy "
+                "hook-sharded embedders warm via real dispatches "
+                "(serve/__main__.py)"
             )
+        if self.mesh_mode:
+            return self._aot_warmup_mesh(specs, r_buckets, packed_buckets)
         sds = jax.ShapeDtypeStruct
         temp_av = sds((), jnp.float32)
         timings = []
@@ -350,6 +431,96 @@ class TpuEmbedder:
             ))
         return timings
 
+    def _aot_warmup_mesh(
+        self, specs: list, r_buckets: list = (), packed_buckets: list = ()
+    ) -> list:
+        """The mesh-mode half of ``aot_warmup``: lower every serving
+        bucket with SHARDED avals (batch rows over ``dp`` via the
+        NamedSharding-carrying ShapeDtypeStructs, params concrete and
+        already placed) so ``.lower().compile()`` bakes the input
+        shardings and the vote collectives into each executable.  Keys
+        are namespaced per mesh shape (``_aot_key``); batch dims are
+        padded to the dp multiple exactly like the dispatch methods pad,
+        so lookup keys always line up.  One consensus executable per
+        (N, S) — the mesh vote always traces its temperature (the fused
+        Pallas variant is single-device-only), so there is no
+        ``use_fused`` split here."""
+        import time as _time
+
+        sds = jax.ShapeDtypeStruct
+        bm = self.batch_multiple
+        dp, tp = self.mesh_shape
+        tag = f"mesh {dp}x{tp}"
+
+        def iav(rows, cols):
+            return sds((rows, cols), jnp.int32, sharding=self.batch_sharding)
+
+        temp_av = sds((), jnp.float32, sharding=self.repl_sharding)
+        timings = []
+        for n, s in specs:
+            s = _seq_bucket(s, self.max_tokens)
+            key = self._aot_key(("vote1", n, s))
+            if key not in self._aot:
+                pad_n = n + (-n) % bm
+                t0 = _time.perf_counter()
+                self._aot[key] = _mesh_embed_and_vote.lower(
+                    self.params, iav(pad_n, s), iav(pad_n, s), temp_av,
+                    n, self.config, self.pooling, self.mesh,
+                ).compile()
+                timings.append((
+                    f"{tag} consensus {n}x{s}", _time.perf_counter() - t0
+                ))
+            pad_b = _bucket(n, self.MAX_DEVICE_BATCH)
+            pad_b += (-pad_b) % bm
+            key = self._aot_key(("embed", pad_b, s))
+            if key not in self._aot:
+                t0 = _time.perf_counter()
+                self._aot[key] = bert.embed.lower(
+                    self.params, iav(pad_b, s), iav(pad_b, s), self.config,
+                    pooling=self.pooling, normalize=True,
+                ).compile()
+                timings.append((
+                    f"{tag} embed {pad_b}x{s}", _time.perf_counter() - t0
+                ))
+            for r in r_buckets:
+                if r < 2:
+                    continue  # R=1 groups dispatch the single-request path
+                key = self._aot_key(("many", r, n, s))
+                if key in self._aot:
+                    continue
+                flat_n = r * n + (-(r * n)) % bm
+                t0 = _time.perf_counter()
+                self._aot[key] = _embed_and_vote_many.lower(
+                    self.params, iav(flat_n, s), iav(flat_n, s), temp_av,
+                    r, n, self.config, self.pooling,
+                ).compile()
+                timings.append((
+                    f"{tag} grouped R={r} {n}x{s}",
+                    _time.perf_counter() - t0,
+                ))
+        for b_rows, l_tokens, k_segs in packed_buckets:
+            # the packed dispatch pads its row dim to the dp multiple
+            # (all-zero rows: segment id 0 is the fully-masked pad slot,
+            # which forwards cleanly), so warm the padded bucket
+            pb = b_rows + (-b_rows) % bm
+            key = self._aot_key(("packed", pb, l_tokens, k_segs))
+            if key in self._aot:
+                continue
+            starts_av = sds(
+                (pb, k_segs), jnp.int32, sharding=self.batch_sharding
+            )
+            t0 = _time.perf_counter()
+            self._aot[key] = bert.embed_packed.lower(
+                self.params, iav(pb, l_tokens), iav(pb, l_tokens),
+                iav(pb, l_tokens), starts_av,
+                self.config, pooling=self.pooling, normalize=True,
+            ).compile()
+            timings.append((
+                f"{tag} packed {pb}x{l_tokens}/k{k_segs}",
+                _time.perf_counter() - t0,
+            ))
+        return timings
+
     def jit_stats(self) -> dict:
         """Jit-cache introspection: AOT bucket count + per-entry-point
         specialization counts (serve /metrics "jit" section; the warmup
@@ -359,6 +530,7 @@ class TpuEmbedder:
             "specializations": {
                 "embed_and_vote": _embed_and_vote._cache_size(),
                 "embed_and_vote_many": _embed_and_vote_many._cache_size(),
+                "mesh_embed_and_vote": _mesh_embed_and_vote._cache_size(),
                 "embed": bert.embed._cache_size(),
                 "stream_vote_update": _stream_vote_update._cache_size(),
                 "stream_vote_update_many": (
@@ -405,11 +577,12 @@ class TpuEmbedder:
             mask = np.pad(mask, ((0, pad_b - b), (0, 0)))
         if self.embed_override is not None:
             return np.asarray(self.embed_override(ids, mask)[:b])
-        exe = self._aot_lookup(("embed", pad_b, ids.shape[1]), ids, mask)
+        exe = self._aot_lookup(
+            self._aot_key(("embed", pad_b, ids.shape[1])), ids, mask
+        )
         if exe is not None:
-            return np.asarray(
-                exe(self.params, jnp.asarray(ids), jnp.asarray(mask))[:b]
-            )
+            dev_ids, dev_mask = self._stage_batch(ids, mask)
+            return np.asarray(exe(self.params, dev_ids, dev_mask)[:b])
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
         emb = bert.embed(
             self.params,
@@ -426,9 +599,11 @@ class TpuEmbedder:
     def supports_packing(self) -> bool:
         """Whether the ragged packed dispatch is usable.  Same gate as
         the AOT fast path: the packed entry bypasses ``put_batch`` /
-        ``embed_override`` (its layout is not the [B, S] the mesh hooks
-        were built for), so mesh-sharded embedders keep the padded
-        paths."""
+        ``embed_override`` (its layout is not the [B, S] the legacy
+        hooks were built for), so hook-sharded embedders keep the
+        padded paths.  First-class mesh mode packs fine — its dispatch
+        pads the packed row dim to the dp multiple and shards rows like
+        any other batch."""
         return self._aot_ready()
 
     def tokenize_ragged(
@@ -457,31 +632,48 @@ class TpuEmbedder:
         warmed packed traffic creates zero jit specializations."""
         b, l = ids.shape
         k = seg_starts.shape[1]
-        exe = self._aot_lookup(("packed", b, l, k), ids, segment_ids)
+        if self.mesh_mode:
+            # pad the row dim to the dp multiple with all-zero rows —
+            # segment id 0 is the fully-masked pad slot, so they forward
+            # cleanly — then slice the pad slots back off
+            pad = (-b) % self.batch_multiple
+            if pad:
+                ids = np.pad(np.asarray(ids), ((0, pad), (0, 0)))
+                segment_ids = np.pad(
+                    np.asarray(segment_ids), ((0, pad), (0, 0))
+                )
+                positions = np.pad(np.asarray(positions), ((0, pad), (0, 0)))
+                seg_starts = np.pad(
+                    np.asarray(seg_starts), ((0, pad), (0, 0))
+                )
+        pb = ids.shape[0]
+        exe = self._aot_lookup(
+            self._aot_key(("packed", pb, l, k)), ids, segment_ids
+        )
         if exe is not None and (
             positions.dtype == np.int32 and seg_starts.dtype == np.int32
         ):
-            return np.asarray(
-                exe(
-                    self.params,
-                    jnp.asarray(ids),
-                    jnp.asarray(segment_ids),
-                    jnp.asarray(positions),
-                    jnp.asarray(seg_starts),
-                )
+            dev_ids, dev_segs, dev_pos, dev_starts = self._stage_batch(
+                ids, segment_ids, positions, seg_starts
             )
+            return np.asarray(
+                exe(self.params, dev_ids, dev_segs, dev_pos, dev_starts)
+            )[:b]
+        dev_ids, dev_segs, dev_pos, dev_starts = self._stage_batch(
+            ids, segment_ids, positions, seg_starts
+        )
         return np.asarray(
             bert.embed_packed(
                 self.params,
-                jnp.asarray(ids),
-                jnp.asarray(segment_ids),
-                jnp.asarray(positions),
-                jnp.asarray(seg_starts),
+                dev_ids,
+                dev_segs,
+                dev_pos,
+                dev_starts,
                 self.config,
                 pooling=self.pooling,
                 normalize=True,
             )
-        )
+        )[:b]
 
     def consensus_confidence(
         self,
@@ -510,6 +702,22 @@ class TpuEmbedder:
     ):
         n = ids.shape[0]
         ids, mask = self._pad_rows(ids, mask)
+        if self.mesh_mode:
+            # one jit-with-shardings dispatch: encoder + the dp-sharded
+            # vote reduction; temperature always traced (the fused
+            # Pallas vote never runs under SPMD), pad rows masked via
+            # n_valid inside the sharded vote
+            exe = self._aot_lookup(
+                self._aot_key(("vote1", n, ids.shape[1])), ids, mask
+            )
+            temp = self._stage_temp(temperature)
+            dev_ids, dev_mask = self._stage_batch(ids, mask)
+            if exe is not None:
+                return exe(self.params, dev_ids, dev_mask, temp)
+            return _mesh_embed_and_vote(
+                self.params, dev_ids, dev_mask, temp,
+                n, self.config, self.pooling, self.mesh,
+            )
         # the Pallas fast path bakes its temperature in; any other
         # value rides the traced-jnp vote (no per-value recompiles)
         use_fused = float(temperature) == DEFAULT_VOTE_TEMPERATURE
@@ -564,14 +772,15 @@ class TpuEmbedder:
             mask = mask.reshape(r * n, s)
         flat_ids, flat_mask = self._pad_rows(ids, mask)
         exe = self._aot_lookup(
-            ("many", r_bucket, n, s), flat_ids, flat_mask
+            self._aot_key(("many", r_bucket, n, s)), flat_ids, flat_mask
         )
         if exe is not None:
+            dev_ids, dev_mask = self._stage_batch(flat_ids, flat_mask)
             conf = exe(
                 self.params,
-                jnp.asarray(flat_ids),
-                jnp.asarray(flat_mask),
-                jnp.asarray(float(temperature), jnp.float32),
+                dev_ids,
+                dev_mask,
+                self._stage_temp(temperature),
             )
             return conf[:r]
         dev_ids, dev_mask = self.put_batch(

@@ -19,30 +19,43 @@ from .compat import shard_map
 
 
 def sharded_cosine_vote(
-    embeddings: jax.Array, mesh: Mesh, temperature: float = 0.05
+    embeddings: jax.Array,
+    mesh: Mesh,
+    temperature: float | jax.Array = 0.05,
+    n_valid: int | None = None,
 ) -> jax.Array:
-    """embeddings[N, D] (N divisible by mesh dp) -> confidence[N].
+    """embeddings[N, D] (N divisible by mesh dp) -> confidence[N_valid].
 
     Matches ops.similarity.cosine_consensus_vote numerically; computed
     distributed: local block matmul against the all-gathered embeddings,
     mean off-diagonal similarity, global max/sum via psum for the softmax.
+
+    ``n_valid`` is the count of real candidate rows when the caller
+    already padded (the mesh serving path pads to the AOT row bucket
+    before dispatch); rows at and past ``n_valid`` are masked out of the
+    mean and the softmax exactly like the internal dp padding, and only
+    the first ``n_valid`` confidences are returned.  ``temperature`` may
+    be a traced scalar: it rides as a replicated shard_map operand, not
+    a closure capture, so this reduction composes under an outer ``jit``
+    (the one-dispatch embed+vote executable in models/embedder.py).
     """
-    n, d = embeddings.shape
+    n = embeddings.shape[0] if n_valid is None else n_valid
     dp = mesh.shape["dp"]
-    if n % dp != 0:
+    if embeddings.shape[0] % dp != 0:
         # pad candidates to the shard grid; padded rows masked out below
-        pad = dp - n % dp
+        pad = dp - embeddings.shape[0] % dp
         embeddings = jnp.pad(embeddings, ((0, pad), (0, 0)))
     np_ = embeddings.shape[0]
+    temp = jnp.asarray(temperature, jnp.float32)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=P("dp", None),
+        in_specs=(P("dp", None), P()),
         out_specs=P("dp"),
         check_vma=False,
     )
-    def vote(x_local):
+    def vote(x_local, temp):
         shard = jax.lax.axis_index("dp")
         local_n = x_local.shape[0]
         # normalize locally (row-wise, no comms)
@@ -67,7 +80,7 @@ def sharded_cosine_vote(
         mean_sim = jnp.sum(jnp.where(valid_col, sims, 0.0), axis=-1) / max(
             n - 1, 1
         )
-        logits = mean_sim / temperature
+        logits = mean_sim / temp
         row_valid = row_ids < n
         logits = jnp.where(row_valid, logits, -jnp.inf)
         # globally-stable softmax: psum-reduced max and sum over shards
@@ -77,7 +90,7 @@ def sharded_cosine_vote(
         denom = jax.lax.psum(jnp.sum(e), "dp")
         return e / denom
 
-    return vote(embeddings)[:n]
+    return vote(embeddings, temp)[:n]
 
 
 def sharded_tally(

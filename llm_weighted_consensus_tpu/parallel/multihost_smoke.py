@@ -156,13 +156,22 @@ def worker_main() -> None:
 
     votes = globalize(votes_np, P("dp", None))
     weights = globalize(weights_np, P("dp"))
-    conf = sharded_tally(votes, weights, mesh)
+    import time
+
+    t0 = time.perf_counter()
+    conf = jax.block_until_ready(sharded_tally(votes, weights, mesh))
+    tally_ms = round((time.perf_counter() - t0) * 1e3, 3)
     assert conf.is_fully_replicated
     out = {
         "process_id": jax.process_index(),
         "num_processes": num,
         "global_devices": len(devices),
+        "mesh_shape": {"dp": num, "tp": dpp},
         "confidence": np.asarray(conf).tolist(),
+        # wall time of the cross-process psum incl. compile on first use —
+        # the number that blows up when dp traffic accidentally rides a
+        # slow transport, which the bare ok-marker never showed
+        "tally_ms": tally_ms,
     }
 
     if dpp > 1:
@@ -220,9 +229,17 @@ def _encoder_phase(mesh, num: int, dpp: int) -> dict:
     )
     ids = globalize(ids_np)
     mask = globalize(mask_np)
+    import time
+
+    t0 = time.perf_counter()
     lowered = fwd.lower(sharded_params, ids, mask)
     compiled = lowered.compile()
-    emb = np.asarray(compiled(sharded_params, ids, mask))
+    compile_ms = round((time.perf_counter() - t0) * 1e3, 3)
+    t0 = time.perf_counter()
+    emb = np.asarray(
+        jax.block_until_ready(compiled(sharded_params, ids, mask))
+    )
+    forward_ms = round((time.perf_counter() - t0) * 1e3, 3)
     err = float(np.max(np.abs(emb - ref)))
 
     report = _collective_boundary_report(
@@ -230,6 +247,8 @@ def _encoder_phase(mesh, num: int, dpp: int) -> dict:
     )
     report["encoder_max_err_vs_unsharded"] = err
     report["encoder_checksum"] = float(np.sum(emb, dtype=np.float64))
+    report["encoder_compile_ms"] = compile_ms
+    report["encoder_forward_ms"] = forward_ms
     return report
 
 

@@ -279,3 +279,50 @@ def shard_embedder(embedder, mesh: Mesh, tp: bool = False) -> None:
     embedder.put_batch = put_batch
     embedder.batch_multiple = dp
     embedder.mesh = mesh
+
+
+def shard_embedder_mesh(embedder, mesh: Mesh) -> None:
+    """First-class mesh serving (``MESH_ENABLED``, serve/config.py).
+
+    Params are placed once at load by the partition-rule tables (batch
+    rows over ``dp``, encoder kernels Megatron-split over ``tp``),
+    dispatch inputs get real NamedShardings instead of the legacy
+    put_batch replicate/split heuristic, and the embedder flips into
+    mesh mode so its AOT table lowers per-(mesh-shape, bucket)
+    executables with the input shardings baked in (models/embedder.py).
+    Unlike ``shard_embedder`` (the hook path above, which disables AOT
+    and packing), mesh mode keeps both.
+    """
+    from ..models.quant import is_quantized
+
+    dp = mesh.shape["dp"]
+    tp = mesh.shape.get("tp", 1)
+    rules = bert_partition_rules(quantized=is_quantized(embedder.params))
+    embedder.params = shard_by_rules(embedder.params, mesh, rules, tp=tp > 1)
+    b_sharding = batch_sharding(mesh)
+    repl = replicated(mesh)
+
+    def put_batch(ids, mask):
+        s = b_sharding if ids.shape[0] % dp == 0 else repl
+        return jax.device_put(ids, s), jax.device_put(mask, s)
+
+    embedder.put_batch = put_batch
+    embedder.batch_multiple = dp
+    embedder.mesh = mesh
+    embedder.mesh_shape = (dp, tp)
+    embedder.batch_sharding = b_sharding
+    embedder.repl_sharding = repl
+    embedder.mesh_mode = True
+
+
+def shard_reranker_mesh(reranker, mesh: Mesh) -> None:
+    """Place a models.reranker.TpuReranker's DeBERTa params on the mesh
+    by its rule table (``MESH_ENABLED``).  The reward forward then rides
+    GSPMD from the param shardings alone — its softmax normalizes over
+    exactly one request's candidates, so the batch stays unsharded."""
+    from ..models.quant import is_quantized
+
+    tp = mesh.shape.get("tp", 1)
+    rules = deberta_partition_rules(quantized=is_quantized(reranker.params))
+    reranker.params = shard_by_rules(reranker.params, mesh, rules, tp=tp > 1)
+    reranker.mesh = mesh

@@ -253,9 +253,12 @@ def _synthetic_params_allowed(allow_synthetic: bool) -> bool:
 
 
 def build_embedder(config: Config, allow_synthetic: bool = False):
-    """The service's device side: an embedder from env config, placed on a
-    (dp, tp) mesh when MESH_DP / MESH_TP are set (batches shard over dp,
-    encoder params Megatron-split over tp — parallel/sharding.py).
+    """The service's device side: an embedder from env config.  With
+    MESH_ENABLED it serves in first-class mesh mode — params placed once
+    by the partition-rule tables, batches sharded over dp, encoder params
+    Megatron-split over tp, per-(mesh-shape, bucket) AOT executables
+    (parallel/sharding.py shard_embedder_mesh); the legacy MESH_DP /
+    MESH_TP knobs keep the older put_batch hook path.
 
     Serving synthetic state — random-init weights (no EMBEDDER_WEIGHTS) or
     the hash tokenizer (no real vocab) — produces embeddings that LOOK
@@ -346,7 +349,22 @@ def build_embedder(config: Config, allow_synthetic: bool = False):
             "(LWC_ALLOW_RANDOM_PARAMS / fake-upstream demo mode).",
             detail,
         )
-    if config.mesh_sp is not None:
+    if config.mesh_enabled:
+        import jax
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import shard_embedder_mesh
+
+        # host-local mesh, same rationale as the legacy branch below;
+        # MESH_SHAPE unset = every local device on dp (tp=1)
+        shape = config.mesh_shape
+        mesh = make_mesh(
+            dp=shape[0] if shape else None,
+            tp=shape[1] if shape else 1,
+            devices=jax.local_devices(),
+        )
+        shard_embedder_mesh(embedder, mesh)
+    elif config.mesh_sp is not None:
         import jax
 
         from ..parallel.mesh import make_mesh
@@ -459,6 +477,19 @@ def build_reranker(config: Config, allow_synthetic: bool = False):
             "(LWC_ALLOW_RANDOM_PARAMS / fake-upstream demo mode).",
             detail,
         )
+    if config.mesh_enabled:
+        import jax
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import shard_reranker_mesh
+
+        shape = config.mesh_shape
+        mesh = make_mesh(
+            dp=shape[0] if shape else None,
+            tp=shape[1] if shape else 1,
+            devices=jax.local_devices(),
+        )
+        shard_reranker_mesh(reranker, mesh)
     return reranker
 
 
@@ -569,15 +600,18 @@ def _warmup_embedder(
     ``aot`` (WARMUP_AOT, default on) compiles each bucket ahead-of-time
     (``TpuEmbedder.aot_warmup``: ``.lower().compile()``, no device
     dispatch) and serves warmed buckets from the embedder's executable
-    table — zero jit specializations after startup.  Mesh-sharded
-    embedders fall back to the dispatch loop below (the AOT lowering
+    table — zero jit specializations after startup.  First-class mesh
+    embedders (MESH_ENABLED) take the AOT branch too: their buckets
+    lower with sharded avals into per-(mesh-shape, bucket) executables.
+    Only the legacy hook-sharded embedders (MESH_DP/MESH_TP/MESH_SP)
+    fall back to the dispatch loop below (the plain-aval AOT lowering
     doesn't carry their input shardings).
 
     ``packed_buckets`` ((B, L, K) triples, wired from the PACKING_*
     knobs) additionally warms the continuous-batching entry
     (``bert.embed_packed``) at each packed-capacity bucket — the small
     fixed set replacing the (R, N, S) lattice on the packed path.  AOT
-    only: packing itself requires the single-device embedder."""
+    only: packing requires the single-device or mesh-mode embedder."""
     import logging
     import time as _time
 
@@ -650,6 +684,8 @@ def _build_cpu_fallback(config: Config, fake_upstream: bool):
                     mesh_dp=None,
                     mesh_tp=1,
                     mesh_sp=None,
+                    mesh_enabled=False,
+                    mesh_shape=None,
                     embedder_quantize="none",
                 ),
                 allow_synthetic=fake_upstream,
@@ -772,6 +808,23 @@ def build_service(
         # specialization counts (asserting "zero new specializations
         # post-warmup" is observable in production, not just in tests)
         metrics.register_provider("jit", embedder.jit_stats)
+    if embedder is not None and getattr(embedder, "mesh_mode", False):
+        # mesh-serving introspection: the shape traffic shards over and
+        # the per-(mesh-shape, bucket) AOT coverage
+
+        def _mesh_stats():
+            dp, tp = embedder.mesh_shape
+            return {
+                "enabled": True,
+                "dp": dp,
+                "tp": tp,
+                "devices": dp * tp,
+                "aot_buckets": sum(
+                    1 for key in embedder._aot if key and key[0] == "mesh"
+                ),
+            }
+
+        metrics.register_provider("mesh", _mesh_stats)
     score_cache = None
     embed_cache = None
     if config.score_cache_ttl_sec > 0:

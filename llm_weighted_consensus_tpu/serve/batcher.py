@@ -98,8 +98,10 @@ class DeviceBatcher:
         # continuous batching (PACKING_ENABLED): embed + consensus items
         # share ONE dispatch key and ride the ragged segment-id layout
         # (serve/packing.py) instead of the per-kind padded buckets;
-        # opt-in — the padded path stays the default contract.  Requires
-        # the single-device embedder (packed layout bypasses mesh hooks).
+        # opt-in — the padded path stays the default contract.  Works on
+        # the single-device embedder AND the first-class mesh mode (its
+        # packed dispatch dp-pads the row dim); only the legacy
+        # hook-sharded embedders decline (supports_packing).
         self.packing = bool(packing) and bool(
             getattr(embedder, "supports_packing", lambda: False)()
         )
@@ -878,6 +880,8 @@ class DeviceBatcher:
             pad_b = _bucket(
                 ids.shape[0], getattr(embedder, "MAX_DEVICE_BATCH", 4096)
             )
+            # mesh/dp embedders pad the bucket up to the dp multiple too
+            pad_b += (-pad_b) % getattr(embedder, "batch_multiple", 1)
         except Exception:
             pad_b = ids.shape[0]
         self._pad_slot_tokens += int(pad_b * ids.shape[1])
@@ -905,8 +909,10 @@ class DeviceBatcher:
             getattr(embedder, "embed_packed", None) is not None
             and getattr(embedder, "supports_packing", lambda: False)()
         ):
-            # e.g. the CPU-fallback or a mesh-sharded embedder mid-swap:
-            # serve every item through its padded path, one by one
+            # e.g. the CPU-fallback or a legacy hook-sharded embedder
+            # mid-swap: serve every item through its padded path, one by
+            # one (first-class mesh embedders pack fine and never land
+            # here)
             return [self._packed_item_fallback(item, embedder) for item in group]
         row_tokens = self.packing_row_tokens
         seg_cap = min(row_tokens, embedder.max_tokens)

@@ -34,6 +34,17 @@ TPU additions:
   inputs (e.g. ``EMBEDDER_MODEL=bert-long-8k``).  Combines with
   ``MESH_DP`` (batch x sequence grid); mutually exclusive with
   ``MESH_TP``.
+* ``MESH_ENABLED`` — first-class mesh serving: embed and consensus
+  dispatches run on a (dp, tp) ICI mesh with params placed once by the
+  partition-rule tables, real input shardings on every dispatch, and
+  per-(mesh-shape, bucket) AOT executables — AOT warmup and packing stay
+  available, unlike the legacy ``MESH_DP``/``MESH_TP`` hook path, which
+  this mode supersedes (mutually exclusive with it and with ``MESH_SP``).
+  Off by default: unset leaves the single-device path untouched.
+* ``MESH_SHAPE`` — the mesh layout for ``MESH_ENABLED`` as ``DPxTP``
+  (e.g. ``4x2`` = batches split 4-way, encoder params 2-way).  Unset
+  with ``MESH_ENABLED=1`` uses every local device on ``dp`` (tp=1);
+  setting it without ``MESH_ENABLED`` is an error.
 * ``MULTIHOST`` — set to 1 on each host of a multi-host slice to call
   ``jax.distributed.initialize`` before mesh construction (parallel/dist.py).
 * ``COMPILE_CACHE_DIR`` — persistent XLA compilation cache: jit
@@ -355,6 +366,25 @@ def _parse_warmup_r(raw) -> list:
     return buckets
 
 
+def _parse_mesh_shape(raw) -> Optional[tuple]:
+    """"4x2" -> (4, 2).  Raises on malformed values, same loud-failure
+    contract as ``_parse_warmup``: a silently dropped mesh shape would
+    serve single-device while claiming a mesh."""
+    if not raw:
+        return None
+    try:
+        dp_tp = str(raw).strip().split("x")
+        dp, tp = int(dp_tp[0]), int(dp_tp[1])
+        if len(dp_tp) != 2 or dp < 1 or tp < 1:
+            raise ValueError
+    except (ValueError, IndexError):
+        raise ValueError(
+            f"MESH_SHAPE {raw!r}: expected DPxTP with positive axes "
+            "(e.g. 4x2 = batches split 4-way, encoder params 2-way)"
+        ) from None
+    return (dp, tp)
+
+
 def _non_negative_int(env: dict, name: str, default: int) -> int:
     value = int(env.get(name, default))
     if value < 0:
@@ -421,6 +451,10 @@ class Config:
     mesh_dp: Optional[int] = None
     mesh_tp: int = 1
     mesh_sp: Optional[int] = None
+    # first-class mesh serving (parallel/sharding.py shard_embedder_mesh):
+    # off by default = the single-device path bit-for-bit
+    mesh_enabled: bool = False
+    mesh_shape: Optional[tuple] = None  # (dp, tp) parsed from "DPxTP"
     compile_cache_dir: Optional[str] = None
     profile_dir: Optional[str] = None
     archive_path: Optional[str] = None
@@ -568,6 +602,8 @@ class Config:
             mesh_dp=int(env["MESH_DP"]) if env.get("MESH_DP") else None,
             mesh_tp=int(env.get("MESH_TP", 1)),
             mesh_sp=int(env["MESH_SP"]) if env.get("MESH_SP") else None,
+            mesh_enabled=env_truthy(env.get("MESH_ENABLED", "0")),
+            mesh_shape=_parse_mesh_shape(env.get("MESH_SHAPE")),
             compile_cache_dir=env.get("COMPILE_CACHE_DIR"),
             profile_dir=env.get("PROFILE_DIR"),
             archive_path=env.get("ARCHIVE_PATH"),
@@ -704,6 +740,22 @@ class Config:
             raise ValueError(
                 f"TRACE_SAMPLE_RATE={config.trace_sample_rate} must be a "
                 "probability in [0, 1]"
+            )
+        if config.mesh_shape is not None and not config.mesh_enabled:
+            raise ValueError(
+                "MESH_SHAPE is set but MESH_ENABLED is not: the shape only "
+                "configures the first-class mesh mode (set MESH_ENABLED=1 "
+                "MESH_SHAPE=4x2)"
+            )
+        if config.mesh_enabled and (
+            config.mesh_dp is not None
+            or config.mesh_tp > 1
+            or config.mesh_sp is not None
+        ):
+            raise ValueError(
+                "MESH_ENABLED is mutually exclusive with the legacy "
+                "MESH_DP/MESH_TP/MESH_SP hooks: the first-class mesh mode "
+                "supersedes them (use MESH_SHAPE=DPxTP)"
             )
         if config.warmup_r and not config.warmup:
             # same loud-failure contract as _parse_warmup: WARMUP_R names

@@ -30,6 +30,7 @@ KNOWN_SECTIONS = (
     "score_cache",
     "traces",
     "jit",
+    "mesh",
 )
 
 

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# dp-scaling bench wrapper — one entry point for the driver and for CI.
+#
+# Runs bench_scaling.py (closed-loop answers/sec at dp=1/2/4/8 through
+# the DeviceBatcher on a mesh-sharded embedder; writes BENCH_r07.json
+# next to the script) with the same hygiene as t1.sh: a hard timeout so
+# a wedged backend can't hang the driver, and JAX_PLATFORMS defaulting
+# to cpu so the virtual 8-device bootstrap is deterministic.  Point it
+# at real hardware with JAX_PLATFORMS=tpu — the bench then runs the
+# wedge-proof pre-flight first and exits 2 with one degraded
+# `tpu-unavailable` record if the tunnel is dead.  Run from the repo
+# root.
+set -o pipefail
+cd "$(dirname "$0")/.."
+timeout -k 10 880 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_scaling.py

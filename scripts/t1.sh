@@ -6,6 +6,10 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 # obs/ tracing tests, explicitly: the glob above already collects them, but
 # this names the file so a collection error there can never pass silently.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_obs=$?; [ $rc -eq 0 ] && rc=$rc_obs; \
+# mesh serving tests, explicitly: the dp×tp gateway path (parity, AOT
+# zero-growth, deadline/watchdog/drain, MESH_ENABLED-off identity) must
+# fail tier-1 by name even if collection of the glob above breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_serving.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_mesh_t=$?; [ $rc -eq 0 ] && rc=$rc_mesh_t; \
 # analysis gate, explicitly: tests/test_analysis.py runs the same checker
 # under pytest, but naming the CLI here means a lint finding, a jaxpr
 # serving-path regression, or a mesh-audit failure (sharding coverage /

@@ -301,6 +301,7 @@ from llm_weighted_consensus_tpu.analysis.mesh_audit import (  # noqa: E402
     audit_hlo_collectives,
     audit_replication,
     audit_rule_coverage,
+    audit_serving_executables,
     run_mesh_audit,
 )
 
@@ -320,6 +321,51 @@ def test_mesh_audit_serving_path_clean():
     serving bucket on the simulated mesh — zero findings."""
     findings = run_mesh_audit()
     assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_mesh_audit_catches_wrong_math_in_committed_executable():
+    """Injected regression: the audit runs against the embedder's ACTUAL
+    AOT table, so an executable whose math is wrong — here the warmed
+    vote1 entry swapped for a same-aval compile that scales the vote —
+    must surface as JXA011 naming the bucket."""
+    from llm_weighted_consensus_tpu.models.embedder import (
+        TpuEmbedder,
+        _mesh_embed_and_vote,
+    )
+    from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
+    from llm_weighted_consensus_tpu.parallel.sharding import (
+        shard_embedder_mesh,
+    )
+
+    mesh = make_mesh(dp=4, tp=2)
+    ref = TpuEmbedder("test-tiny", max_tokens=64, seed=0, quantize="none")
+    emb = TpuEmbedder("test-tiny", max_tokens=64, seed=0, quantize="none")
+    shard_embedder_mesh(emb, mesh)
+    n, s = 4, 16
+    emb.aot_warmup([(n, s)])
+
+    pad_n = n + (-n) % emb.batch_multiple
+    iav = SDS((pad_n, s), jnp.int32, sharding=emb.batch_sharding)
+    temp_av = SDS((), jnp.float32, sharding=emb.repl_sharding)
+    wrong = (
+        jax.jit(
+            lambda p, i, m, t: 1.5
+            * _mesh_embed_and_vote(
+                p, i, m, t, n, emb.config, emb.pooling, emb.mesh
+            )
+        )
+        .lower(emb.params, iav, iav, temp_av)
+        .compile()
+    )
+    emb._aot[emb._aot_key(("vote1", n, s))] = wrong
+
+    findings, _ = audit_serving_executables(
+        emb, ref, specs=((n, s),), r_buckets=(), packed_buckets=()
+    )
+    hits = [
+        f for f in findings if f.rule == "JXA011" and "vote1" in f.path
+    ]
+    assert hits, "\n".join(f.render() for f in findings)
 
 
 def test_coverage_clean_on_toy_tree():

@@ -1,0 +1,323 @@
+"""Mesh serving path (ISSUE PR 9): dp×tp first-class mesh mode under
+the gateway.
+
+What this pins, on the tier-1 8-virtual-device CPU mesh:
+
+* batcher end-to-end parity — the dp-sharded embedder returns the same
+  results as the single-device embedder through the same DeviceBatcher,
+  on the padded, packed, and int8-pallas-interpret paths;
+* per-(mesh-shape, bucket) AOT — ``aot_warmup`` on a mesh embedder
+  compiles namespaced executables and post-warmup mesh traffic creates
+  ZERO new jit specializations (the ISSUE acceptance);
+* the PR 4/5 per-item contracts carry through the mesh path unchanged:
+  deadline shed is still a 504 before dispatch, the watchdog brackets
+  every dispatch, drain still waits for queued work;
+* config: ``MESH_ENABLED`` unset is today's single-device behavior, and
+  the knob validation refuses half-configured or legacy-mixed setups.
+
+Jit caches are process-global and SHARED across embedder instances, so
+every zero-growth assertion is a delta whose reference dispatches all
+run BEFORE the first snapshot (the test_aot.py discipline).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from llm_weighted_consensus_tpu.models import configs
+from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
+from llm_weighted_consensus_tpu.parallel.sharding import shard_embedder_mesh
+from llm_weighted_consensus_tpu.serve.batcher import DeviceBatcher
+from llm_weighted_consensus_tpu.serve.config import Config
+from llm_weighted_consensus_tpu.serve.metrics import Metrics
+
+TINY = configs.TEST_TINY
+DP, TP = 4, 2
+N, S, R = 4, 16, 2
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def make_embedder(**kw):
+    kw.setdefault("config", TINY)
+    return TpuEmbedder("test-tiny", max_tokens=32, seed=3, **kw)
+
+
+def mesh_embedder(dp=DP, tp=TP, **kw):
+    emb = make_embedder(**kw)
+    shard_embedder_mesh(emb, make_mesh(dp=dp, tp=tp))
+    return emb
+
+
+PACKED_KW = dict(
+    packing=True,
+    packing_row_tokens=64,
+    packing_max_rows=4,
+    packing_max_segments=8,
+)
+
+TEXTS = [f"candidate number {i % 3} for the mesh" for i in range(6)]
+
+
+# -- batcher e2e parity vs single-device --------------------------------------
+
+
+def test_mesh_batcher_padded_matches_single_device():
+    """Concurrent embed + consensus through the batcher on the dp-sharded
+    embedder ≡ the single-device embedder's direct answers."""
+    ref = make_embedder()
+    emb = mesh_embedder()
+    metrics = Metrics()
+    batcher = DeviceBatcher(emb, metrics, window_ms=20.0)
+
+    async def run():
+        return await asyncio.gather(
+            batcher.consensus(TEXTS),
+            batcher.consensus(list(reversed(TEXTS))),
+            batcher.embed(TEXTS[:3]),
+        )
+
+    (conf_a, tok_a), (conf_b, _), (vecs, _) = go(run())
+    np.testing.assert_allclose(
+        conf_a, np.asarray(ref.consensus_confidence(TEXTS)), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        conf_b,
+        np.asarray(ref.consensus_confidence(list(reversed(TEXTS)))),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        vecs, ref.embed_texts(TEXTS[:3]), atol=1e-5
+    )
+    assert tok_a == ref.token_count(TEXTS)
+    # same-shape consensus requests still coalesce into one dispatch
+    assert metrics.snapshot()["series"]["device:batch:consensus"][
+        "count"
+    ] == 1
+
+
+def test_mesh_batcher_packed_matches_single_device():
+    """The packed path on the mesh embedder (rows padded to the dp
+    multiple, one packed dispatch) ≡ the single-device padded answers."""
+    ref = make_embedder()
+    emb = mesh_embedder()
+    assert emb.supports_packing()
+    metrics = Metrics()
+    batcher = DeviceBatcher(emb, metrics, window_ms=20.0, **PACKED_KW)
+
+    async def run():
+        return await asyncio.gather(
+            batcher.embed(TEXTS[:2]),
+            batcher.consensus(TEXTS[:3], 0.05),
+            batcher.consensus(TEXTS, 0.07),
+        )
+
+    (vecs, _), (conf_a, _), (conf_b, _) = go(run())
+    np.testing.assert_allclose(vecs, ref.embed_texts(TEXTS[:2]), atol=1e-5)
+    np.testing.assert_allclose(
+        conf_a,
+        np.asarray(ref.consensus_confidence(TEXTS[:3], temperature=0.05)),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        conf_b,
+        np.asarray(ref.consensus_confidence(TEXTS, temperature=0.07)),
+        atol=1e-5,
+    )
+    assert metrics.snapshot()["series"]["device:batch:packed"]["count"] == 1
+
+
+def test_mesh_batcher_int8_pallas_matches_single_device():
+    """The int8-pallas interpret-mode kernels run under GSPMD exactly as
+    on one device: batcher answers agree with the single-device int8
+    embedder (same quantized params, seed-identical)."""
+    ref = make_embedder(quantize="int8-pallas")
+    emb = mesh_embedder(quantize="int8-pallas")
+    batcher = DeviceBatcher(emb, Metrics(), window_ms=20.0)
+
+    async def run():
+        return await asyncio.gather(
+            batcher.consensus(TEXTS), batcher.embed(TEXTS[:2])
+        )
+
+    (conf, _), (vecs, _) = go(run())
+    np.testing.assert_allclose(
+        conf, np.asarray(ref.consensus_confidence(TEXTS)), atol=1e-5
+    )
+    np.testing.assert_allclose(vecs, ref.embed_texts(TEXTS[:2]), atol=1e-5)
+
+
+# -- per-(mesh-shape, bucket) AOT ---------------------------------------------
+
+
+def test_mesh_aot_zero_specializations_under_mixed_load():
+    """The ISSUE acceptance: mesh-sharded ``aot_warmup`` precompiles
+    every (mesh-shape, bucket) executable and post-warmup mesh traffic
+    creates zero jit-specialization growth."""
+    emb = mesh_embedder()
+    timings = emb.aot_warmup(
+        [(N, S)], r_buckets=[R], packed_buckets=[(4, 64, 8)]
+    )
+    # consensus + embed + grouped + packed, one executable each
+    assert len(timings) == 4, [label for label, _ in timings]
+    # keys are namespaced per mesh shape — a 2x4 mesh could never
+    # collide with these executables
+    assert set(emb._aot) == {
+        ("mesh", DP, TP, "vote1", N, S),
+        ("mesh", DP, TP, "embed", 16, S),
+        ("mesh", DP, TP, "many", R, N, S),
+        ("mesh", DP, TP, "packed", 4, 64, 8),
+    }
+
+    rng = np.random.default_rng(12)
+    ids = rng.integers(3, TINY.vocab_size, (N, S)).astype(np.int32)
+    mask = np.ones((N, S), np.int32)
+    pids = rng.integers(3, TINY.vocab_size, (4, 64)).astype(np.int32)
+    pseg = np.ones((4, 64), np.int32)
+    ppos = np.tile(np.arange(64, dtype=np.int32), (4, 1))
+    pstarts = np.zeros((4, 8), np.int32)
+
+    stats0 = emb.jit_stats()["specializations"]
+    out = [
+        np.asarray(emb.consensus_confidence_tokens(ids, mask)),
+        np.asarray(
+            emb.consensus_confidence_tokens(ids, mask, temperature=0.2)
+        ),
+        np.asarray(emb.embed_tokens(ids, mask)),
+        np.asarray(
+            emb.consensus_confidence_tokens_many(
+                np.stack([ids] * R), np.stack([mask] * R)
+            )
+        ),
+        np.asarray(emb.embed_packed(pids, pseg, ppos, pstarts)),
+    ]
+    assert all(np.all(np.isfinite(o)) for o in out)
+    assert emb.jit_stats()["specializations"] == stats0
+
+
+def test_mesh_aot_warmup_allowed_legacy_hooks_still_refused():
+    """Mesh mode takes the AOT branch ``aot_warmup`` used to refuse;
+    the legacy hook-sharded shapes still raise (their executables would
+    silently miss the put_batch placement)."""
+    emb = mesh_embedder()
+    assert emb._aot_ready()
+    legacy = make_embedder()
+    legacy.batch_multiple = 2  # the legacy dp hook contract
+    with pytest.raises(RuntimeError, match="mesh"):
+        legacy.aot_warmup([(N, S)])
+
+
+# -- PR 4/5 per-item contracts through the mesh path --------------------------
+
+
+def test_mesh_deadline_shed_before_dispatch_is_504():
+    from llm_weighted_consensus_tpu.errors import DeadlineExceededError
+    from llm_weighted_consensus_tpu.resilience import Deadline
+
+    metrics = Metrics()
+    batcher = DeviceBatcher(mesh_embedder(), metrics, window_ms=20.0)
+
+    async def run():
+        token = Deadline(0.0005).activate()
+        try:
+            with pytest.raises(DeadlineExceededError) as ei:
+                await batcher.embed(["too late"])
+            assert ei.value.status() == 504
+        finally:
+            Deadline.deactivate(token)
+        emb, tokens = await batcher.embed(["in time"])
+        assert emb.shape[0] == 1 and tokens > 0
+
+    go(run())
+    assert batcher.shed_deadline == 1
+    assert metrics.snapshot()["series"]["device:shed:deadline"][
+        "errors"
+    ] == 1
+
+
+def test_mesh_watchdog_brackets_dispatches():
+    from llm_weighted_consensus_tpu.resilience import DeviceWatchdog
+
+    wd = DeviceWatchdog(60_000.0)  # generous: must never trip here
+    batcher = DeviceBatcher(
+        mesh_embedder(), Metrics(), window_ms=5.0, watchdog=wd
+    )
+
+    async def run():
+        await asyncio.gather(batcher.embed(["one"]), batcher.embed(["two"]))
+
+    go(run())
+    assert wd.dispatches >= 1
+    assert wd.snapshot()["active_dispatches"] == 0
+    assert wd.healthy() is True
+
+
+def test_mesh_drain_waits_for_queued_work():
+    batcher = DeviceBatcher(mesh_embedder(), Metrics(), window_ms=10.0)
+
+    async def run():
+        assert batcher.idle()
+        t = asyncio.ensure_future(batcher.embed(["queued"]))
+        await asyncio.sleep(0)
+        assert not batcher.idle()
+        assert await batcher.drain(5.0) is True
+        assert batcher.idle()
+        emb, _ = await t
+        assert emb.shape[0] == 1
+
+    go(run())
+
+
+# -- config: off by default, loud on misconfiguration -------------------------
+
+
+def test_mesh_config_off_by_default():
+    config = Config.from_env({})
+    assert config.mesh_enabled is False
+    assert config.mesh_shape is None
+    # and a fresh embedder is the single-device path: no mesh state, no
+    # key namespacing
+    emb = make_embedder()
+    assert emb.mesh_mode is False
+    assert emb._aot_key(("vote1", N, S)) == ("vote1", N, S)
+
+
+def test_mesh_config_parses_and_validates():
+    config = Config.from_env(
+        {"MESH_ENABLED": "1", "MESH_SHAPE": "4x2"}
+    )
+    assert config.mesh_enabled is True
+    assert config.mesh_shape == (4, 2)
+    with pytest.raises(ValueError, match="MESH_ENABLED is not"):
+        Config.from_env({"MESH_SHAPE": "4x2"})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Config.from_env({"MESH_ENABLED": "1", "MESH_DP": "2"})
+    with pytest.raises(ValueError, match="DPxTP"):
+        Config.from_env({"MESH_ENABLED": "1", "MESH_SHAPE": "4x0"})
+
+
+def test_build_embedder_mesh_enabled_round_trip():
+    """serve wiring end-to-end: MESH_ENABLED + MESH_SHAPE builds the
+    sharded embedder, registers its mesh, and serves."""
+    from llm_weighted_consensus_tpu.serve.__main__ import build_embedder
+
+    config = Config.from_env(
+        {
+            "EMBEDDER_MODEL": "test-tiny",
+            "EMBEDDER_MAX_TOKENS": "64",
+            "MESH_ENABLED": "1",
+            "MESH_SHAPE": f"{DP}x{TP}",
+        }
+    )
+    embedder = build_embedder(config)
+    assert embedder.mesh_mode is True
+    assert embedder.mesh_shape == (DP, TP)
+    assert dict(embedder.mesh.shape) == {"dp": DP, "tp": TP}
+    out = embedder.embed_texts(["mesh round trip"])
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, atol=1e-5)

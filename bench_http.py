@@ -54,6 +54,17 @@ services — tracing off, ``TRACE_SAMPLE_RATE=0.01``, and ``1.0`` —
 reporting the p50 inflation of each traced setting over off.  The
 acceptance bar is <= 2%% at 1%% sampling.
 
+``--mesh-faults`` replaces the trio with the degraded-mesh scenario
+(resilience/meshfault.py): a dp x tp mesh service with the fault
+ladder armed and every rung AOT-warmed, driven through three phases —
+healthy closed loop, the SAME traffic with a scripted persistent
+device fault landing mid-burst (downsize + in-flight re-dispatch),
+and after an explicit recovery probe upsizes back.  Reports goodput
+and p99 per phase plus the served ``meshfault`` counters; the numbers
+that matter are the degraded-phase goodput (~dp_rung/dp of healthy,
+zero non-504 errors) and the absence of a compile stall at the
+downsize (the rung executables were warmed at startup).
+
 ``--mixed-lengths`` replaces the trio with the continuous-batching
 scenario (serve/packing.py): the SAME open-loop mixed-length
 /consensus arrival process (short-head/long-tail lengths, mixed
@@ -175,7 +186,7 @@ async def _start_service(
     await runner.setup()
     port = unused_port()
     await web.TCPSite(runner, "127.0.0.1", port).start()
-    return runner, fake_runner, port, embedder
+    return runner, fake_runner, port, embedder, app
 
 
 async def _drive(session, url, bodies, concurrency, warmup_bursts=2):
@@ -685,7 +696,7 @@ async def bench_trace_overhead(args) -> None:
     # interleaving plus a median over per-round p50s cancels the drift
     services = []
     for label, rate in settings:
-        runner, fake_runner, port, _ = await _start_service(
+        runner, fake_runner, port, _, _ = await _start_service(
             args.model,
             args.window_ms,
             args.quantize,
@@ -841,7 +852,7 @@ async def bench_mixed_lengths(args) -> None:
     results = {}
     padded_capacity = None
     for label, env in settings:
-        runner, fake_runner, port, _ = await _start_service(
+        runner, fake_runner, port, _, _ = await _start_service(
             args.model, args.window_ms, args.quantize, extra_env=env
         )
         url = f"http://127.0.0.1:{port}/consensus"
@@ -969,11 +980,177 @@ async def bench_mixed_lengths(args) -> None:
     )
 
 
+async def bench_mesh_faults(args) -> None:
+    """Goodput through a device fault (resilience/meshfault.py): the
+    /consensus scorer on a dp x tp mesh, driven closed-loop in three
+    phases.  Phase A is the healthy baseline.  Before phase B the
+    manager's DEVICE_FAULT_PLAN seam is armed with ``script=persistent``,
+    so the first device dispatch of the burst dies exactly the way a
+    lost chip does: the batcher classifies, downsizes one ladder rung
+    (dp halves, tp survives), and re-dispatches the in-flight groups on
+    the warmed rung executables — phase B's goodput and error counts ARE
+    the incident behavior.  Phase C runs after an explicit recovery
+    probe restores the full shape.  No open loop here on purpose: the
+    question is what admitted requests experience through the shape
+    change, not how the door sheds."""
+    import aiohttp
+
+    from llm_weighted_consensus_tpu.resilience.meshfault import (
+        DeviceFaultPlan,
+    )
+    from llm_weighted_consensus_tpu.serve.gateway import (
+        BATCHER_KEY,
+        MESHFAULT_KEY,
+    )
+
+    dp, tp = 4, 2
+    n = max(2, min(args.n, 8))
+    concurrency = min(args.concurrency, 8)
+    # EMBEDDER_MAX_TOKENS=32 + 96-word texts: every request tokenizes to
+    # the cap, so serving traffic hits exactly the (n, 32) bucket the
+    # WARMUP spec names and warm_ladder pre-compiles on every rung —
+    # phase B measures the downsize, not a mid-incident compile
+    extra_env = {
+        "MESH_ENABLED": "1",
+        "MESH_SHAPE": f"{dp}x{tp}",
+        "MESH_FAULT_ENABLED": "1",
+        "MESH_FAULT_TRANSIENT_RETRIES": "2",
+        "EMBEDDER_MAX_TOKENS": "32",
+        "WARMUP": f"{n}x32",
+        "WARMUP_R": "2,4,8",
+        "WARMUP_AOT": "1",
+    }
+    runner, fake_runner, port, embedder, app = await _start_service(
+        args.model, args.window_ms, args.quantize, extra_env=extra_env
+    )
+    meshfault = app[MESHFAULT_KEY]
+    batcher = app[BATCHER_KEY]
+    base = f"http://127.0.0.1:{port}"
+    url = base + "/consensus"
+
+    bodies = [
+        json.dumps({"input": texts, "temperature": 0.05})
+        for texts in make_requests(args.requests, n)
+    ]
+
+    async def drive_counting(session, warmup_bursts=0):
+        sem = asyncio.Semaphore(concurrency)
+        lat: list = []
+        shed_504 = 0
+        errors = 0
+
+        async def one(b, record=True):
+            nonlocal shed_504, errors
+            async with sem:
+                t0 = time.perf_counter()
+                async with session.post(url, data=b) as resp:
+                    await resp.read()
+                    if not record:
+                        return
+                    if resp.status == 200:
+                        lat.append((time.perf_counter() - t0) * 1e3)
+                    elif resp.status == 504:
+                        shed_504 += 1
+                    else:
+                        errors += 1
+
+        for _ in range(warmup_bursts):
+            burst = (bodies * ((concurrency // len(bodies)) + 1))[
+                :concurrency
+            ]
+            await asyncio.gather(*(one(b, record=False) for b in burst))
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(b) for b in bodies))
+        total = time.perf_counter() - t0
+        return {
+            "goodput_rps": round(len(lat) / total, 3),
+            **_percentiles(lat or [0.0]),
+            "shed_504": shed_504,
+            "errors": errors,
+        }
+
+    async def readyz(session):
+        async with session.get(base + "/readyz") as resp:
+            return resp.status, await resp.json()
+
+    loop = asyncio.get_running_loop()
+    try:
+        async with aiohttp.ClientSession(
+            headers={"content-type": "application/json"}
+        ) as session:
+            healthy = await drive_counting(session, warmup_bursts=2)
+
+            # arm the seam: the next device dispatch dies persistently,
+            # mid-burst, with the rest of the phase in flight behind it
+            meshfault.fault_plan = DeviceFaultPlan.parse(
+                "script=persistent"
+            )
+            degraded = await drive_counting(session)
+            ready_status, ready_body = await readyz(session)
+
+            # the recovery probe runs where downsize ran: on the
+            # dispatch executor, serialized with device work
+            recovered_ok = await loop.run_in_executor(
+                batcher._executor, meshfault.try_recover
+            )
+            recovered = await drive_counting(session)
+            ready_after_status, ready_after = await readyz(session)
+
+            async with session.get(base + "/metrics") as resp:
+                counters = (await resp.json()).get("meshfault")
+    finally:
+        await runner.cleanup()
+        await fake_runner.cleanup()
+
+    emit(
+        "/consensus?mesh-faults",
+        degraded["goodput_rps"],
+        "goodput answers/sec",
+        requests=len(bodies),
+        concurrency=concurrency,
+        n_candidates=n,
+        mesh_shape=f"{dp}x{tp}",
+        fault_plan="script=persistent",
+        healthy=healthy,
+        degraded=degraded,
+        recovered=recovered,
+        degraded_vs_healthy=(
+            round(
+                degraded["goodput_rps"] / healthy["goodput_rps"], 3
+            )
+            if healthy["goodput_rps"]
+            else None
+        ),
+        recovered_vs_healthy=(
+            round(
+                recovered["goodput_rps"] / healthy["goodput_rps"], 3
+            )
+            if healthy["goodput_rps"]
+            else None
+        ),
+        readyz_during=(ready_status, ready_body),
+        readyz_after=(ready_after_status, ready_after),
+        recovery_probe_ok=bool(recovered_ok),
+        meshfault=counters,
+        note=(
+            "closed-loop /consensus on a dp x tp mesh through a "
+            "scripted persistent device fault: value = degraded-phase "
+            "goodput (one downsize rung, in-flight groups "
+            "re-dispatched on warmed executables); acceptance = zero "
+            "'errors' in every phase, readyz_during 200 with "
+            "degraded_mesh, recovered goodput back near healthy"
+        ),
+    )
+
+
 async def main_async(args) -> None:
     import aiohttp
 
     if args.trace_overhead:
         await bench_trace_overhead(args)
+        return
+    if args.mesh_faults:
+        await bench_mesh_faults(args)
         return
     if args.mixed_lengths:
         await bench_mixed_lengths(args)
@@ -990,7 +1167,7 @@ async def main_async(args) -> None:
         import os
 
         os.environ.setdefault("FAKE_UPSTREAM_DELAY_MS", "100")
-    runner, fake_runner, port, embedder = await _start_service(
+    runner, fake_runner, port, embedder, _ = await _start_service(
         args.model,
         args.window_ms,
         args.quantize,
@@ -1096,6 +1273,16 @@ def main() -> None:
         "reports p50 inflation per setting vs off",
     )
     parser.add_argument(
+        "--mesh-faults",
+        action="store_true",
+        help="run the degraded-mesh scenario instead of the endpoint "
+        "trio: a 4x2 mesh service with the fault ladder armed and "
+        "AOT-warmed, /consensus driven healthy -> scripted persistent "
+        "device fault (downsize + in-flight re-dispatch) -> recovery; "
+        "reports goodput and p99 per phase plus the served meshfault "
+        "counters",
+    )
+    parser.add_argument(
         "--mixed-lengths",
         action="store_true",
         help="run the continuous-batching scenario instead of the "
@@ -1137,6 +1324,14 @@ def main() -> None:
         raise SystemExit(2)
     if args.model is None:
         args.model = "bge-large-en" if probe["backend"] == "tpu" else "test-tiny"
+    if args.mesh_faults and probe["backend"] != "tpu":
+        # the 4x2 mesh needs 8 devices; off-TPU, simulate them the way
+        # the mesh tests and the audit subprocess do (parallel/dist.py)
+        import os
+
+        from llm_weighted_consensus_tpu.parallel.dist import force_cpu_env
+
+        force_cpu_env(os.environ, 8)
     asyncio.run(main_async(args))
 
 

@@ -40,6 +40,12 @@ TPU chip is rented:
   agree to float32 reduction-reordering tolerance, and a ``jit_stats``
   bracket asserts the dispatches really rode the audited executables
   (zero specialization growth).
+* **JXA012 fault-ladder coverage** — the mesh fault-domain downsize
+  ladder (``resilience/meshfault.py``): every fallback rung must hold a
+  full AOT bucket set after ``warm_ladder`` (a missing rung bucket means
+  a mid-incident downsize compiles under fire), and driving the public
+  dispatch on each downsized rung must pass the JXA011 parity gate
+  against the single-device reference with zero specialization growth.
 
 Device plumbing: the checks need ``dp*tp`` devices.  Under tier-1
 pytest the conftest already forces 8 virtual CPU devices, so everything
@@ -607,6 +613,130 @@ def _measure_buckets(
     return findings, measured
 
 
+def _audit_fault_ladder(
+    model: str, dp: int, tp: int, specs, r_buckets, packed_buckets
+) -> List[Finding]:
+    """JXA012: walk the MeshFaultManager downsize ladder as an incident
+    would — warm it, then downsize rung by rung — and on every fallback
+    rung assert (a) each serving bucket has a committed AOT executable
+    under that rung's ``("mesh", dp, tp)`` namespace and (b) the public
+    dispatch agrees with the single-device reference with zero jit
+    growth (the JXA011 gate, re-applied to the degraded shapes).  A
+    rung that fails either check means the fault path itself is the
+    outage: a downsize mid-incident would compile — or worse, compute
+    wrong numbers — exactly when the service can least afford it."""
+    import numpy as np
+
+    from ..models.embedder import TpuEmbedder, _bucket, _seq_bucket
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import shard_embedder_mesh
+    from ..resilience import MeshFaultManager
+
+    findings: List[Finding] = []
+    ref = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
+    embedder = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
+    shard_embedder_mesh(embedder, make_mesh(dp=dp, tp=tp))
+    manager = MeshFaultManager(embedder, shape=(dp, tp))
+    r2 = [r for r in r_buckets if r >= 2]
+    manager.warm_ladder(list(specs), r2, list(packed_buckets))
+
+    rng = np.random.default_rng(0)
+    vocab = embedder.config.vocab_size
+    atol = 1e-4
+    # reference outputs FIRST: the module-level jit caches are shared, so
+    # the zero-growth brackets below must see rung traffic only
+    cases = []
+    for n, s in specs:
+        s = _seq_bucket(s, embedder.max_tokens)
+        ids = rng.integers(3, vocab, (n, s)).astype(np.int32)
+        mask = np.ones((n, s), np.int32)
+        ref_out = np.asarray(ref.consensus_confidence_tokens(ids, mask))
+        cases.append((n, s, ids, mask, ref_out))
+
+    for rung_dp, rung_tp in manager.build_ladder()[1:]:
+        label = f"ladder:{rung_dp}x{rung_tp}"
+        if not manager.downsize():
+            findings.append(
+                Finding(
+                    rule="JXA012",
+                    path=f"mesh:{label}",
+                    line=0,
+                    message=(
+                        "downsize() refused a declared ladder rung: the "
+                        "ladder the manager walks is not the ladder it "
+                        "declared"
+                    ),
+                )
+            )
+            break
+        # (a) full AOT bucket coverage under this rung's key namespace
+        bm = embedder.batch_multiple
+        keys = []
+        for n, s in specs:
+            s = _seq_bucket(s, embedder.max_tokens)
+            keys.append(("vote1", n, s))
+            pad_b = _bucket(n, embedder.MAX_DEVICE_BATCH)
+            pad_b += (-pad_b) % bm
+            keys.append(("embed", pad_b, s))
+            keys.extend(("many", r, n, s) for r in r2)
+        for b, l, k in packed_buckets:
+            pb = b + (-b) % bm
+            keys.append(("packed", pb, l, k))
+        for key in keys:
+            if embedder._aot.get(embedder._aot_key(key)) is None:
+                findings.append(
+                    Finding(
+                        rule="JXA012",
+                        path=f"mesh:{label}",
+                        line=0,
+                        message=(
+                            f"no AOT executable at fallback-rung bucket "
+                            f"{key}: warm_ladder did not cover it, so a "
+                            f"downsize to {rung_dp}x{rung_tp} would "
+                            "compile mid-incident"
+                        ),
+                    )
+                )
+        # (b) parity + zero growth through the public dispatch ON the rung
+        before = embedder.jit_stats()["specializations"]
+        for n, s, ids, mask, ref_out in cases:
+            got = np.asarray(embedder.consensus_confidence_tokens(ids, mask))
+            if not np.allclose(got, ref_out, atol=atol, rtol=1e-4):
+                worst = float(np.max(np.abs(got - ref_out)))
+                findings.append(
+                    Finding(
+                        rule="JXA012",
+                        path=f"mesh:{label}",
+                        line=0,
+                        message=(
+                            "degraded-rung dispatch diverges from the "
+                            "single-device reference (max abs diff "
+                            f"{worst:.2e} > {atol}): the re-dispatched "
+                            "answers after a real downsize would be wrong"
+                        ),
+                    )
+                )
+        after = embedder.jit_stats()["specializations"]
+        grew = {
+            name: f"{before.get(name, 0)}->{count}"
+            for name, count in after.items()
+            if count > before.get(name, 0)
+        }
+        if grew:
+            findings.append(
+                Finding(
+                    rule="JXA012",
+                    path=f"mesh:{label}",
+                    line=0,
+                    message=(
+                        "rung dispatches bypassed the warmed executables "
+                        f"and lazily jitted instead ({grew})"
+                    ),
+                )
+            )
+    return findings
+
+
 def _measure_reward_packed(
     mesh, packed_buckets
 ) -> Tuple[List[Finding], Dict[str, Dict[str, float]]]:
@@ -762,6 +892,12 @@ def _audit_in_process(
         _env_specs(), _env_r_buckets(), _env_packed_buckets(),
     )
     findings += bucket_findings
+    # JXA012 rung figures carry no committed budget baseline; the ladder
+    # audit contributes findings only, never entries in ``measured``.
+    findings += _audit_fault_ladder(
+        _env_model(), dp, tp,
+        _env_specs(), _env_r_buckets(), _env_packed_buckets(),
+    )
     if write_budgets:
         _write_budgets_file(budgets_path, measured, budgets)
     else:

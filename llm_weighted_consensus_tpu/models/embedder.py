@@ -521,6 +521,18 @@ class TpuEmbedder:
             ))
         return timings
 
+    def aot_mesh_shapes(self) -> list:
+        """The (dp, tp) shapes with warmed mesh-mode AOT executables,
+        sorted largest-first — the fault-domain ladder audit
+        (analysis/mesh_audit.py JXA012) and the ``meshfault`` /metrics
+        section read this to prove every fallback rung was prewarmed."""
+        shapes = {
+            (key[1], key[2])
+            for key in self._aot
+            if key and key[0] == "mesh"
+        }
+        return sorted(shapes, reverse=True)
+
     def jit_stats(self) -> dict:
         """Jit-cache introspection: AOT bucket count + per-entry-point
         specialization counts (serve /metrics "jit" section; the warmup

@@ -34,7 +34,14 @@ one its own module with a pure, clock-injectable core:
 * ``watchdog``  — a device dispatch watchdog (begin/end brackets around
   every batched TPU dispatch + a monitor thread): a hung PJRT dispatch
   marks the device unhealthy — readiness flips, admission sheds
-  device-dependent work, and a configured CPU fallback takes over.
+  device-dependent work, and a configured CPU fallback takes over;
+* ``meshfault`` — mesh fault domains for the dp×tp serving mesh:
+  dispatch-failure classification (transient/persistent/watchdog-
+  overdue), an AOT-prewarmed downsize ladder that re-shards onto the
+  surviving submesh instead of collapsing to the CPU twin, deadline-
+  bounded in-flight re-dispatch, a recovery prober that upsizes back,
+  and the seeded ``DEVICE_FAULT_PLAN`` injection seam at the embedder
+  dispatch boundary.
 
 Everything is opt-in: a ``ResiliencePolicy`` of ``None`` (the default
 everywhere) preserves pre-resilience behavior byte-for-byte.  Pure-core
@@ -52,6 +59,14 @@ from .budget import RetryBudget, current_retry_budget  # noqa: F401
 from .deadline import Deadline, current_deadline  # noqa: F401
 from .faults import FaultInjectionTransport, FaultPlan  # noqa: F401
 from .hedge import HedgePolicy, LatencyTracker  # noqa: F401
+from .meshfault import (  # noqa: F401
+    DeviceFaultPlan,
+    InjectedHangError,
+    InjectedPersistentError,
+    InjectedTransientError,
+    MeshFaultManager,
+    classify_dispatch_error,
+)
 from .quorum import QuorumTracker  # noqa: F401
 from .watchdog import DeviceWatchdog  # noqa: F401
 
@@ -100,14 +115,20 @@ __all__ = [
     "BreakerRegistry",
     "CircuitBreaker",
     "Deadline",
+    "DeviceFaultPlan",
     "DeviceWatchdog",
     "FaultInjectionTransport",
     "FaultPlan",
     "HedgePolicy",
+    "InjectedHangError",
+    "InjectedPersistentError",
+    "InjectedTransientError",
     "LatencyTracker",
+    "MeshFaultManager",
     "QuorumTracker",
     "ResiliencePolicy",
     "RetryBudget",
+    "classify_dispatch_error",
     "current_deadline",
     "current_retry_budget",
 ]

@@ -75,6 +75,11 @@ class AdmissionController:
         # the adaptive limit lives under the hard cap; without the
         # adaptive mode it just mirrors max_inflight
         self.limit = float(config.max_inflight)
+        # surviving-chip fraction under a degraded mesh
+        # (resilience/meshfault.py rescale hook): scales the hard cap —
+        # and the AIMD limit, so it converges from the right side —
+        # while the mesh serves at reduced shape; 1.0 = full capacity
+        self._mesh_scale = 1.0
         self._baseline_ms: Optional[float] = None
         self._last_decrease = -math.inf
         self.admitted = 0
@@ -93,6 +98,8 @@ class AdmissionController:
                 return self._shed(reason)
         cap = self.config.max_inflight
         if cap > 0:
+            if self._mesh_scale != 1.0:
+                cap = max(1, int(cap * self._mesh_scale))
             effective = (
                 max(self.config.min_limit, int(self.limit))
                 if self.config.adaptive
@@ -108,6 +115,23 @@ class AdmissionController:
         self.inflight = max(0, self.inflight - 1)
         if self.config.adaptive and self.config.max_inflight > 0:
             self._adapt(latency_ms, error)
+
+    def rescale(self, scale: float) -> None:
+        """Scale admission to the surviving chip fraction (a
+        MeshFaultManager rescale hook): a downsized mesh admits
+        proportionally less work instead of queueing the overflow into
+        blown deadlines.  The AIMD limit is rescaled by the same ratio
+        so it starts the new regime near the right value rather than
+        decaying toward it one congestion sample at a time; scale=1.0
+        (recovery upsize) restores the full cap."""
+        scale = max(0.0, float(scale))
+        prev = self._mesh_scale
+        self._mesh_scale = scale
+        if self.config.adaptive and self.config.max_inflight > 0 and prev > 0:
+            self.limit = min(
+                float(self.config.max_inflight),
+                max(float(self.config.min_limit), self.limit * scale / prev),
+            )
 
     def _shed(self, reason: str) -> str:
         self.shed[reason] = self.shed.get(reason, 0) + 1
@@ -157,6 +181,8 @@ class AdmissionController:
             "max_inflight": self.config.max_inflight,
             "max_queue_depth": self.config.max_queue_depth,
         }
+        if self._mesh_scale != 1.0:
+            out["mesh_scale"] = round(self._mesh_scale, 4)
         if self.config.adaptive:
             out["limit"] = round(self.limit, 2)
             if self._baseline_ms is not None:

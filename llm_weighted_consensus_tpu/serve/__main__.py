@@ -770,8 +770,8 @@ def build_service(
     # --fake-upstream is demo/test mode: synthetic embedder params are
     # allowed (still logged); production startup refuses them
     embedder = build_embedder(config, allow_synthetic=fake_upstream)
+    packed_buckets = []
     if embedder is not None and config.warmup:
-        packed_buckets = []
         if config.packing_enabled and embedder.supports_packing():
             # the hot packed-capacity buckets (serve/packing.py): every
             # pow2 row count up to the per-call cap at full seq width
@@ -797,6 +797,48 @@ def build_service(
             aot=config.warmup_aot,
             packed_buckets=packed_buckets,
         )
+    # mesh fault domains (MESH_FAULT_ENABLED, resilience/meshfault.py):
+    # the downsize ladder is declared — and every fallback rung AOT-warmed
+    # under its own ("mesh", dp, tp) key namespace — at startup, so a
+    # mid-traffic downsize is a param re-shard + executable-table swap,
+    # never a compile storm
+    meshfault = None
+    if (
+        config.mesh_fault_enabled
+        and embedder is not None
+        and getattr(embedder, "mesh_mode", False)
+    ):
+        import logging
+
+        from ..resilience import MeshFaultManager
+
+        meshfault = MeshFaultManager(
+            embedder,
+            shape=embedder.mesh_shape,
+            transient_retries=config.mesh_fault_transient_retries,
+            probe_millis=config.mesh_fault_probe_millis,
+            fault_plan=config.device_fault_injection_plan(),
+        )
+        _mf_log = logging.getLogger("lwc.serve")
+        _mf_log.info(
+            "mesh fault ladder: %s",
+            " -> ".join(f"{d}x{t}" for d, t in meshfault.build_ladder()),
+        )
+        if config.warmup and config.warmup_aot and embedder._aot_ready():
+            from ..models.embedder import _seq_bucket
+
+            snapped = list(
+                dict.fromkeys(
+                    (n, _seq_bucket(s, embedder.max_tokens))
+                    for n, s in config.warmup
+                )
+            )
+            for label, dt in meshfault.warm_ladder(
+                snapped, config.warmup_r, packed_buckets
+            ):
+                _mf_log.info(
+                    "mesh fault ladder AOT %s compiled in %.1fs", label, dt
+                )
     reranker = build_reranker(config, allow_synthetic=fake_upstream)
     from .metrics import Metrics
 
@@ -825,6 +867,10 @@ def build_service(
             }
 
         metrics.register_provider("mesh", _mesh_stats)
+    if meshfault is not None:
+        # degraded-mesh introspection: current/full shape, epoch,
+        # downsize/upsize/re-dispatch counters, faulted device ids
+        metrics.register_provider("meshfault", meshfault.snapshot)
     score_cache = None
     embed_cache = None
     if config.score_cache_ttl_sec > 0:
@@ -883,12 +929,26 @@ def build_service(
             watchdog=watchdog,
             fallback_embedder=fallback_embedder,
             fallback_context=fallback_context,
+            meshfault=meshfault,
         )
     if watchdog is not None:
         import logging
 
         _log = logging.getLogger("lwc.serve")
         _batcher = batcher
+        _meshfault = meshfault
+
+        def _mesh_absorbs() -> bool:
+            # MESH_FAULT_ENABLED precedence (serve/config.py): the wedge
+            # goes to the downsize ladder, not straight to the CPU twin —
+            # the twin is the post-exhaustion last resort, and the
+            # batcher's fault handler flips it only when downsize()
+            # reports the ladder spent
+            return (
+                _meshfault is not None
+                and _batcher is not None
+                and not _batcher._use_fallback
+            )
 
         def _on_trip(kind: str, overdue_ms: float) -> None:
             _log.error(
@@ -897,12 +957,17 @@ def build_service(
                 kind,
                 overdue_ms,
                 (
-                    "; routing device work to the CPU fallback"
+                    "; escalating to the mesh fault ladder"
+                    if _mesh_absorbs()
+                    else "; routing device work to the CPU fallback"
                     if _batcher is not None
                     and _batcher.fallback_embedder is not None
                     else "; device endpoints will shed until it completes"
                 ),
             )
+            if _mesh_absorbs():
+                _meshfault.note_watchdog_trip()
+                return
             if _batcher is not None:
                 _batcher.use_fallback(True)
 
@@ -911,6 +976,11 @@ def build_service(
                 "device watchdog recovered: the overdue dispatch "
                 "completed, device traffic resumes"
             )
+            if _meshfault is not None:
+                # mesh-fault mode never flipped the fallback on trip, and
+                # a post-exhaustion fallback must survive the recovery —
+                # a completed wedge does not un-exhaust the ladder
+                return
             if _batcher is not None:
                 _batcher.use_fallback(False)
 
@@ -933,6 +1003,12 @@ def build_service(
     admission = AdmissionController(
         config.admission_config(), device_gate=_device_gate
     )
+    if meshfault is not None:
+        # every shape change rescales admission (hard cap + AIMD limit)
+        # and the batcher's group capacity to the surviving chip fraction
+        meshfault.rescale_hooks.append(admission.rescale)
+        if batcher is not None:
+            meshfault.rescale_hooks.append(batcher.rescale_capacity)
     weight_fetchers = WeightFetchers()
     tables = None
     if embedder is not None:
@@ -1014,6 +1090,7 @@ def build_service(
         batcher=batcher,
         caches=(score_cache, embed_cache),
         watchdog=watchdog,
+        meshfault=meshfault,
         drain_timeout_ms=config.drain_timeout_millis,
     )
     app = build_app(
@@ -1030,6 +1107,7 @@ def build_service(
         admission=admission,
         lifecycle=lifecycle,
         watchdog=watchdog,
+        meshfault=meshfault,
         # TRACE_*: request tracing (obs/); None preserves untraced behavior
         trace_sink=config.trace_sink(),
     )
@@ -1080,6 +1158,41 @@ def build_service(
             watchdog.stop()
 
         app.on_cleanup.append(_stop_watchdog)
+    if (
+        meshfault is not None
+        and config.mesh_fault_probe_millis > 0
+        and batcher is not None
+    ):
+        # recovery prober (MESH_FAULT_PROBE_MILLIS > 0): while degraded,
+        # periodically re-validate the full mesh and upsize back.  The
+        # probe runs on the batcher's dispatch executor, which serializes
+        # the upsize re-shard with in-flight dispatches.
+        probe_sec = config.mesh_fault_probe_millis / 1e3
+        prober_tasks: list = []
+
+        async def _start_mesh_prober(app):
+            loop = asyncio.get_running_loop()
+
+            async def _probe_loop():
+                while True:
+                    await asyncio.sleep(probe_sec)
+                    if meshfault.degraded:
+                        await loop.run_in_executor(
+                            batcher._executor, meshfault.try_recover
+                        )
+
+            prober_tasks.append(loop.create_task(_probe_loop()))
+
+        async def _stop_mesh_prober(app):
+            for task in prober_tasks:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+
+        app.on_startup.append(_start_mesh_prober)
+        app.on_cleanup.append(_stop_mesh_prober)
     return app
 
 

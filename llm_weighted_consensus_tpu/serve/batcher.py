@@ -45,7 +45,10 @@ import numpy as np
 
 
 class _Item:
-    __slots__ = ("kind", "key", "payload", "future", "deadline", "span")
+    __slots__ = (
+        "kind", "key", "payload", "future", "deadline", "span",
+        "redispatches",
+    )
 
     def __init__(self, kind, key, payload, future, deadline=None, span=None):
         self.kind = kind
@@ -61,6 +64,10 @@ class _Item:
         # whose ambient context is stale, so device timing children hang
         # off this explicit handle instead of contextvars
         self.span = span
+        # times this item was re-queued after a classified device fault
+        # (resilience/meshfault.py) — bounded so a fault loop can never
+        # recycle one item forever
+        self.redispatches = 0
 
 
 class DeviceBatcher:
@@ -86,6 +93,7 @@ class DeviceBatcher:
         watchdog=None,
         fallback_embedder=None,
         fallback_context=None,
+        meshfault=None,
         packing: bool = False,
         packing_row_tokens: int = 512,
         packing_max_rows: int = 8,
@@ -139,6 +147,12 @@ class DeviceBatcher:
         self.fallback_embedder = fallback_embedder
         self.fallback_context = fallback_context
         self._use_fallback = False
+        # mesh fault domains (resilience/meshfault.py): classifies
+        # dispatch failures, injects DEVICE_FAULT_PLAN faults at the
+        # _dispatch seam, and downsizes the mesh on persistent loss —
+        # the batcher re-queues the failed group's live items onto the
+        # new shape instead of failing them
+        self.meshfault = meshfault
         self.shed_queue_full = 0
         self.shed_deadline = 0
         self.cancelled_items = 0
@@ -163,6 +177,11 @@ class DeviceBatcher:
         # chunking by rows turns a burst into pipeline_depth-overlappable
         # sub-dispatches sized for good MXU utilization
         self.max_rows = max(1, int(max_rows))
+        # full-mesh capacity, kept so rescale_capacity is idempotent in
+        # the scale (downsize 8->4->2 then recovery back to 1.0 restores
+        # the configured values exactly)
+        self._base_max_rows = self.max_rows
+        self._base_max_batch = self.max_batch
         self._pending: list = []
         self._flusher: Optional[asyncio.Task] = None
         self._sem: Optional[asyncio.Semaphore] = None
@@ -349,6 +368,16 @@ class DeviceBatcher:
         on_trip) or back to the device (on_recover).  A bare flag read
         by the dispatch path; no-op without a fallback embedder."""
         self._use_fallback = bool(active)
+
+    def rescale_capacity(self, scale: float) -> None:
+        """Scale per-dispatch capacity to the surviving chip fraction
+        (a MeshFaultManager rescale hook): a half-size mesh gets half
+        the encoder rows per group, so dispatch wall time — and the
+        deadline-shed EWMA feeding on it — stays roughly flat through a
+        downsize.  scale=1.0 restores the configured capacity exactly."""
+        scale = max(0.0, float(scale))
+        self.max_rows = max(1, int(self._base_max_rows * scale))
+        self.max_batch = max(1, int(self._base_max_batch * scale))
 
     def idle(self) -> bool:
         """No pending items and no dispatch in flight."""
@@ -648,12 +677,20 @@ class DeviceBatcher:
         self._inflight[token] = t0
         # device wall-time children on each traced item's batcher span,
         # bracketing exactly what the watchdog brackets (the executor
-        # hop + the PJRT call)
+        # hop + the PJRT call); the mesh epoch stamps which shape served
+        # the dispatch, so a re-dispatched item's span tree shows one
+        # child per epoch it touched
+        extra = (
+            {"mesh_epoch": self.meshfault.epoch}
+            if self.meshfault is not None
+            else {}
+        )
         dspans = [
             item.span.child(
                 "device:dispatch",
                 kind=item.kind,
                 batch_size=len(group),
+                **extra,
             )
             for item in group
             if item.span is not None
@@ -670,9 +707,22 @@ class DeviceBatcher:
             )
         except Exception as e:
             error = True
-            for item in group:
-                if not item.future.done():
-                    item.future.set_exception(e)
+            # device-fault triage (resilience/meshfault.py): a classified
+            # fault re-queues the group's live items (after a downsize,
+            # when the fault is persistent) instead of failing them;
+            # ordinary application errors — and anything raised by the
+            # CPU twin — keep the fail-the-group path byte-for-byte
+            kind = (
+                self.meshfault.classify(e)
+                if self.meshfault is not None and not self._use_fallback
+                else None
+            )
+            if kind is not None:
+                await self._handle_device_fault(loop, kind, e, group)
+            else:
+                for item in group:
+                    if not item.future.done():
+                        item.future.set_exception(e)
             self._observe(group, t0, token, error=True)
         else:
             for item, result in zip(group, results):
@@ -685,6 +735,71 @@ class DeviceBatcher:
             for dspan in dspans:
                 dspan.finish("error" if error else None)
             self._sem.release()
+
+    # each item survives at most this many fault re-queues before it
+    # inherits the device exception — a backstop above the natural bound
+    # (ladder length x transient retries) so a pathological fault plan
+    # can never recycle one item indefinitely
+    REDISPATCH_LIMIT = 8
+
+    async def _handle_device_fault(self, loop, kind, exc, group) -> None:
+        """React to a classified device fault: persistent faults walk
+        the downsize ladder (on the dispatch executor, which serializes
+        the embedder re-shard with real dispatches); a spent ladder
+        flips to the CPU twin — the last resort, per the
+        DEVICE_WATCHDOG_CPU_FALLBACK x MESH_ENABLED precedence — and a
+        spent ladder WITHOUT a twin fails the group.  Every surviving
+        path re-queues the group's live items for re-dispatch on the
+        new (or retried) shape."""
+        if kind == "persistent":
+            ok = await loop.run_in_executor(
+                self._executor, self.meshfault.downsize
+            )
+            if not ok:
+                if self.fallback_embedder is not None:
+                    self.use_fallback(True)
+                else:
+                    for item in group:
+                        if not item.future.done():
+                            item.future.set_exception(exc)
+                    return
+        self._requeue(group, exc)
+
+    def _requeue(self, group, exc) -> None:
+        """Put a faulted group's items back at the FRONT of the pending
+        queue (they are the oldest work), bounded by their propagated
+        deadlines — an item past budget sheds 504 here exactly as the
+        pre-dispatch shed does — and by REDISPATCH_LIMIT."""
+        from ..errors import DeadlineExceededError
+
+        live = []
+        for item in group:
+            if item.future.done():
+                self.cancelled_items += 1
+                continue
+            if item.deadline is not None and item.deadline.expired():
+                if item.span is not None:
+                    item.span.annotate(shed="deadline")
+                item.future.set_exception(
+                    DeadlineExceededError("deadline expired during re-dispatch")
+                )
+                self.shed_deadline += 1
+                if self.metrics is not None:
+                    self.metrics.observe(
+                        "device:shed:deadline", 0.0, error=True
+                    )
+                continue
+            if item.redispatches >= self.REDISPATCH_LIMIT:
+                item.future.set_exception(exc)
+                continue
+            item.redispatches += 1
+            live.append(item)
+        if not live:
+            return
+        self._pending[:0] = live
+        self.meshfault.note_redispatch(len(live))
+        if self._wake is not None:
+            self._wake.set()
 
     def _observe(self, group, t0, token, *, error: bool) -> None:
         end = time.perf_counter()
@@ -812,7 +927,15 @@ class DeviceBatcher:
                 with self.fallback_context():
                     return fn(group, self.fallback_embedder)
             return fn(group, self.fallback_embedder)
-        return fn(group, self.embedder)
+        if self.meshfault is not None:
+            # the DEVICE_FAULT_PLAN seam, on the dispatch thread where
+            # a real device failure would raise; the CPU-twin branch
+            # above never injects (the plan models the device tier)
+            self.meshfault.maybe_inject()
+        results = fn(group, self.embedder)
+        if self.meshfault is not None:
+            self.meshfault.note_dispatch_ok()
+        return results
 
     def _dispatch_embed(self, group: list, embedder) -> list:
         max_tokens = group[0].payload[1]

@@ -240,7 +240,42 @@ shutdown):
 * ``DEVICE_WATCHDOG_CPU_FALLBACK`` — ``1`` builds a CPU twin of the
   embedder at startup and routes embed/consensus dispatches to it while
   the device is unhealthy (degraded but alive beats shedding).
-  Requires ``DEVICE_WATCHDOG_MILLIS`` > 0.
+  Requires ``DEVICE_WATCHDOG_MILLIS`` > 0.  Precedence under
+  ``MESH_ENABLED``: the twin is single-device, so collapsing a live
+  dp×tp mesh onto it is an outage with extra steps — in mesh mode this
+  flag therefore ALSO requires ``MESH_FAULT_ENABLED``, and the twin
+  only serves after the downsize ladder is exhausted (a watchdog trip
+  marks the next classified fault persistent instead of flipping the
+  fallback directly).
+
+Mesh fault domains (resilience/meshfault.py; requires ``MESH_ENABLED``,
+all opt-in — unset keeps the PR 9 mesh path byte-for-byte):
+
+* ``MESH_FAULT_ENABLED`` — ``1`` arms the mesh fault-domain subsystem:
+  dispatch failures classify transient/persistent at the
+  embedder/batcher seam, a persistent fault downsizes the mesh one
+  rung along the dp-halving ladder (params re-shard onto the surviving
+  submesh, dispatch swaps to that rung's AOT executables — every rung
+  is warmed at startup), in-flight items re-dispatch on the new shape
+  bounded by their deadlines, admission/batcher capacity rescale to
+  the surviving chips, and ``/readyz`` stays 200 with a
+  ``degraded_mesh`` flag.  Counters ride the ``meshfault`` /metrics
+  section.
+* ``MESH_FAULT_TRANSIENT_RETRIES`` — consecutive transient dispatch
+  faults tolerated (each re-queues and retries on the SAME shape)
+  before the streak escalates to persistent and walks the ladder.
+  Default 2.
+* ``MESH_FAULT_PROBE_MILLIS`` — recovery-prober period: while
+  degraded, every interval the full mesh is re-validated and, when
+  healthy, the mesh upsizes back to the full shape (capacity restored,
+  ``degraded_mesh`` clears).  ``0`` (the default) disables automatic
+  recovery.
+* ``DEVICE_FAULT_PLAN`` — deterministic device-fault injection at the
+  dispatch seam (the ``FAULT_PLAN`` contract at the embedder boundary),
+  e.g. ``seed=42,persistent=0.05`` or
+  ``script=ok|transient|persistent|ok,hang_ms=50`` with kinds
+  ``transient`` / ``persistent`` / ``hang``.  Chaos runs and tier-1
+  drills only; never set in production.
 
 Shed/drain/watchdog counters and the inflight/queue-depth gauges
 surface as the ``admission`` / ``device_watchdog`` / ``lifecycle`` /
@@ -533,6 +568,18 @@ class Config:
     device_watchdog_millis: float = 0.0
     device_watchdog_interval_millis: float = 0.0  # 0 = auto (timeout/4)
     device_watchdog_cpu_fallback: bool = False
+    # mesh fault domains (resilience/meshfault.py): classification +
+    # downsize ladder + re-dispatch; requires mesh_enabled, off = the
+    # PR 9 mesh path untouched
+    mesh_fault_enabled: bool = False
+    # consecutive transient faults tolerated before escalating to a
+    # persistent (ladder-walking) fault
+    mesh_fault_transient_retries: int = 2
+    # recovery-prober period; 0 = no automatic upsize
+    mesh_fault_probe_millis: float = 0.0
+    # deterministic device-fault injection spec (DeviceFaultPlan.parse);
+    # None = off (chaos runs and tier-1 drills only)
+    device_fault_plan: Optional[str] = None
     # request tracing (obs/): head-sample rate, forced-on flag (capture
     # only degraded/shed/error at rate 0), ring capacity, JSONL dir.
     # trace_sink() returns None when nothing enables tracing, keeping
@@ -690,6 +737,14 @@ class Config:
             device_watchdog_cpu_fallback=env_truthy(
                 env.get("DEVICE_WATCHDOG_CPU_FALLBACK", "0")
             ),
+            mesh_fault_enabled=env_truthy(
+                env.get("MESH_FAULT_ENABLED", "0")
+            ),
+            mesh_fault_transient_retries=_non_negative_int(
+                env, "MESH_FAULT_TRANSIENT_RETRIES", 2
+            ),
+            mesh_fault_probe_millis=get_f("MESH_FAULT_PROBE_MILLIS", 0),
+            device_fault_plan=env.get("DEVICE_FAULT_PLAN"),
             trace_sample_rate=get_f("TRACE_SAMPLE_RATE", 0),
             trace_enabled=env_truthy(env.get("TRACE_ENABLED", "0")),
             trace_ring=max(1, int(env.get("TRACE_RING", 256))),
@@ -756,6 +811,42 @@ class Config:
                 "MESH_ENABLED is mutually exclusive with the legacy "
                 "MESH_DP/MESH_TP/MESH_SP hooks: the first-class mesh mode "
                 "supersedes them (use MESH_SHAPE=DPxTP)"
+            )
+        if config.mesh_fault_enabled and not config.mesh_enabled:
+            raise ValueError(
+                "MESH_FAULT_ENABLED=1 needs MESH_ENABLED=1: fault domains, "
+                "the downsize ladder and re-dispatch all operate on the "
+                "first-class serving mesh (set MESH_ENABLED=1, optionally "
+                "MESH_SHAPE=DPxTP)"
+            )
+        if config.device_fault_plan and not config.mesh_fault_enabled:
+            raise ValueError(
+                "DEVICE_FAULT_PLAN is set but MESH_FAULT_ENABLED is not: "
+                "the injection seam lives in the mesh fault-domain "
+                "subsystem, so the plan would silently never fire (set "
+                "MESH_FAULT_ENABLED=1, or unset DEVICE_FAULT_PLAN)"
+            )
+        if config.mesh_fault_probe_millis < 0:
+            raise ValueError(
+                f"MESH_FAULT_PROBE_MILLIS={config.mesh_fault_probe_millis} "
+                "must be >= 0 (0 = no automatic recovery upsize)"
+            )
+        if (
+            config.mesh_enabled
+            and config.device_watchdog_cpu_fallback
+            and not config.mesh_fault_enabled
+        ):
+            # precedence contract: the CPU twin is single-device, so in
+            # mesh mode it must be the LAST resort — after the downsize
+            # ladder is exhausted — never the first response to a trip.
+            # Without the fault-domain subsystem there is no ladder, and
+            # a watchdog trip would collapse the whole mesh onto one CPU.
+            raise ValueError(
+                "DEVICE_WATCHDOG_CPU_FALLBACK=1 with MESH_ENABLED=1 needs "
+                "MESH_FAULT_ENABLED=1: the CPU twin is single-device, so "
+                "in mesh mode it is the last resort AFTER the downsize "
+                "ladder is exhausted — enabling it without the ladder "
+                "would collapse the mesh to one CPU on the first trip"
             )
         if config.warmup_r and not config.warmup:
             # same loud-failure contract as _parse_warmup: WARMUP_R names
@@ -849,6 +940,14 @@ class Config:
         from ..resilience import FaultPlan
 
         return FaultPlan.parse(self.fault_plan)
+
+    def device_fault_injection_plan(self):
+        """Parsed DEVICE_FAULT_PLAN, or None (chaos/drill runs only)."""
+        if not self.device_fault_plan:
+            return None
+        from ..resilience import DeviceFaultPlan
+
+        return DeviceFaultPlan.parse(self.device_fault_plan)
 
     def trace_sink(self):
         """The configured TraceSink, or None when nothing enables

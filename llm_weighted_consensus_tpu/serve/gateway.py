@@ -40,6 +40,8 @@ METRICS_KEY: web.AppKey = web.AppKey("metrics", Metrics)
 BATCHER_KEY: web.AppKey = web.AppKey("batcher", object)
 # the drain/readiness state machine (serve/lifecycle.py), when wired
 LIFECYCLE_KEY: web.AppKey = web.AppKey("lifecycle", object)
+# the mesh fault-domain manager (resilience/meshfault.py), when wired
+MESHFAULT_KEY: web.AppKey = web.AppKey("meshfault", object)
 
 DONE = b"data: [DONE]\n\n"
 SSE_HEADERS = {
@@ -456,6 +458,7 @@ def build_app(
     admission=None,
     lifecycle=None,
     watchdog=None,
+    meshfault=None,
     trace_sink=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
@@ -519,6 +522,8 @@ def build_app(
     app[METRICS_KEY] = metrics
     if lifecycle is not None:
         app[LIFECYCLE_KEY] = lifecycle
+    if meshfault is not None:
+        app[MESHFAULT_KEY] = meshfault
     if batcher is not None:
         app[BATCHER_KEY] = batcher
 

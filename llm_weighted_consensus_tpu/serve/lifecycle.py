@@ -41,6 +41,7 @@ class Lifecycle:
         batcher=None,
         caches=(),
         watchdog=None,
+        meshfault=None,
         drain_timeout_ms: float = 10000.0,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
@@ -51,6 +52,11 @@ class Lifecycle:
         # everything the final dispatches produced
         self.caches = [c for c in caches if c is not None]
         self.watchdog = watchdog
+        # mesh fault domains (resilience/meshfault.py): a downsized-but-
+        # serving mesh stays READY — /readyz reports 200 with a
+        # degraded_mesh flag, never 503, because proportional capacity
+        # is still capacity
+        self.meshfault = meshfault
         self.drain_timeout_ms = float(drain_timeout_ms)
         self.clock = clock
         self.state = READY
@@ -144,7 +150,16 @@ def health_handlers(lifecycle: Optional[Lifecycle]):
             return web.json_response({"ready": True})
         ok, reason = lifecycle.ready()
         if ok:
-            return web.json_response({"ready": True})
+            body = {"ready": True}
+            mf = lifecycle.meshfault
+            if mf is not None and mf.degraded:
+                # still 200: the downsized mesh serves real traffic at
+                # proportional capacity — the balancer must keep routing
+                # here, operators read the flag (and the meshfault
+                # /metrics section) for the degradation
+                body["degraded_mesh"] = True
+                body["mesh_shape"] = list(mf.current_shape)
+            return web.json_response(body)
         return web.json_response(
             {"ready": False, "reason": reason}, status=503
         )

@@ -31,6 +31,7 @@ KNOWN_SECTIONS = (
     "traces",
     "jit",
     "mesh",
+    "meshfault",
 )
 
 

@@ -10,6 +10,11 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -p
 # zero-growth, deadline/watchdog/drain, MESH_ENABLED-off identity) must
 # fail tier-1 by name even if collection of the glob above breaks.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_serving.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_mesh_t=$?; [ $rc -eq 0 ] && rc=$rc_mesh_t; \
+# mesh fault-domain tests, explicitly: the degraded-mesh serving path
+# (classification, downsize ladder, re-dispatch, admission rescale,
+# recovery, the seeded acceptance drill) must fail tier-1 by name even
+# if collection of the glob above breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_meshfault.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_mf=$?; [ $rc -eq 0 ] && rc=$rc_mf; \
 # analysis gate, explicitly: tests/test_analysis.py runs the same checker
 # under pytest, but naming the CLI here means a lint finding, a jaxpr
 # serving-path regression, or a mesh-audit failure (sharding coverage /

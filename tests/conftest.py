@@ -49,3 +49,53 @@ def pytest_configure(config):
         "soak: sustained-load / overload scenarios (bench_http.py --overload, "
         "scripts/chaos.sh overload+SIGTERM); always also marked slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "requires_multiprocess_collectives: needs a backend that "
+        "implements cross-process collectives (a real multi-host slice); "
+        "on the CPU backend these become STRICT xfails — an unexpected "
+        "pass fails the suite, flagging the marker as stale "
+        "(KNOWN_FAILURES.md)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Backend-keyed environmental gating (KNOWN_FAILURES.md contract,
+    mechanized): tests marked ``requires_multiprocess_collectives``
+    dispatch cross-process collectives XLA's CPU backend rejects with
+    ``INVALID_ARGUMENT: Multiprocess computations aren't implemented on
+    the CPU backend``.  On that backend they are strict xfails — tier-1
+    stays green without hiding a capability change: the day the backend
+    (or a real multi-host slice) runs them, the unexpected pass FAILS
+    the suite until the marker is deleted.  Any other backend runs them
+    for real."""
+    marked = [
+        item
+        for item in items
+        if item.get_closest_marker("requires_multiprocess_collectives")
+    ]
+    if not marked:
+        return
+    # backend probe is lazy (only when a marked test is collected) so
+    # pure-core test selections never pay a jax backend init here
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"  # no usable backend: the collectives can't run
+    if backend != "cpu":
+        return
+    import pytest
+
+    xfail = pytest.mark.xfail(
+        strict=True,
+        reason=(
+            "XLA's CPU backend does not implement multiprocess "
+            "collectives; runs on a real multi-host slice.  strict: an "
+            "unexpected pass means this gate is stale — delete the "
+            "marker (KNOWN_FAILURES.md contract)."
+        ),
+    )
+    for item in marked:
+        item.add_marker(xfail)

@@ -368,6 +368,42 @@ def test_mesh_audit_catches_wrong_math_in_committed_executable():
     assert hits, "\n".join(f.render() for f in findings)
 
 
+def test_mesh_audit_catches_unwarmed_fault_ladder_rung():
+    """Injected regression for JXA012: with warm_ladder neutered, every
+    fallback rung is missing its AOT buckets — the ladder audit must name
+    the uncovered buckets AND flag that rung dispatches lazily jitted
+    instead of riding committed executables."""
+    from llm_weighted_consensus_tpu.analysis.mesh_audit import (
+        _audit_fault_ladder,
+    )
+    from llm_weighted_consensus_tpu.resilience import MeshFaultManager
+
+    real = MeshFaultManager.warm_ladder
+    MeshFaultManager.warm_ladder = lambda self, *a, **k: []
+    try:
+        findings = _audit_fault_ladder(
+            "test-tiny", 4, 2, ((4, 16),), (), ()
+        )
+    finally:
+        MeshFaultManager.warm_ladder = real
+    missing = [
+        f
+        for f in findings
+        if f.rule == "JXA012" and "no AOT executable" in f.message
+    ]
+    lazy = [
+        f
+        for f in findings
+        if f.rule == "JXA012" and "lazily jitted" in f.message
+    ]
+    assert missing and lazy, "\n".join(f.render() for f in findings)
+    # both fallback rungs of the 4x2 ladder are implicated
+    assert {f.path for f in missing} == {
+        "mesh:ladder:2x2",
+        "mesh:ladder:1x2",
+    }
+
+
 def test_coverage_clean_on_toy_tree():
     assert audit_rule_coverage(_TOY_RULES, _TOY_TREE, "toy") == []
 

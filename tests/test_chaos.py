@@ -343,3 +343,98 @@ def test_quorum_under_stall_is_deterministic():
     a, _ = run_quorum_under_stall()
     b, _ = run_quorum_under_stall()
     assert normalize(a) == normalize(b)
+
+
+# -- mesh fault-domain drill (resilience/meshfault.py) ------------------------
+
+
+def run_mesh_fault_drill(seed, rounds=10):
+    """Sustained mesh traffic under a seeded probabilistic
+    ``DEVICE_FAULT_PLAN`` mix (transient + persistent + hang), with the
+    CPU twin behind the ladder: returns the per-round answer signatures,
+    the clean-run references, and the manager/plan tallies."""
+    pytest.importorskip("jax")
+    import numpy as np
+
+    from llm_weighted_consensus_tpu.models import configs
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+    from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
+    from llm_weighted_consensus_tpu.parallel.sharding import (
+        shard_embedder_mesh,
+    )
+    from llm_weighted_consensus_tpu.resilience import (
+        DeviceFaultPlan,
+        MeshFaultManager,
+    )
+    from llm_weighted_consensus_tpu.serve.batcher import DeviceBatcher
+    from llm_weighted_consensus_tpu.serve.metrics import Metrics
+
+    def embedder():
+        return TpuEmbedder(
+            "test-tiny", max_tokens=32, seed=3, config=configs.TEST_TINY
+        )
+
+    ref = embedder()
+    emb = embedder()
+    shard_embedder_mesh(emb, make_mesh(dp=4, tp=2))
+    plan = DeviceFaultPlan(
+        seed=seed,
+        probabilities={"transient": 0.2, "persistent": 0.1, "hang": 0.1},
+        hang_ms=5.0,
+    )
+    mgr = MeshFaultManager(emb, shape=(4, 2), fault_plan=plan)
+    mgr.build_ladder()
+    batcher = DeviceBatcher(
+        emb,
+        Metrics(),
+        window_ms=5.0,
+        meshfault=mgr,
+        # exhaustion safety net: the drill must end with answers, never
+        # a dead mesh, whatever the seed deals
+        fallback_embedder=embedder(),
+    )
+    rounds_texts = [
+        [f"drill round {r} candidate {i % 3}" for i in range(6)]
+        for r in range(rounds)
+    ]
+
+    async def drive():
+        # one event loop for the whole drill: the batcher's flusher and
+        # wake event bind to the loop of the first submit
+        out = []
+        for texts in rounds_texts:
+            conf, _ = await batcher.consensus(texts)
+            out.append(conf)
+        return out
+
+    confs = go(drive())
+    sigs = [np.asarray(c).round(5).tobytes() for c in confs]
+    refs = [
+        np.asarray(ref.consensus_confidence(texts))
+        for texts in rounds_texts
+    ]
+    answers = [np.asarray(c) for c in confs]
+    return sigs, (answers, refs), mgr.snapshot(), plan.snapshot()
+
+
+def test_mesh_fault_drill_answers_survive_the_fault_mix():
+    import numpy as np
+
+    _, (answers, refs), mgr_snap, plan_snap = run_mesh_fault_drill(SEED)
+    # every round answered correctly despite the injected mix: faults
+    # cost re-dispatches and rungs, never wrong numbers
+    for got, want in zip(answers, refs):
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    assert sum(plan_snap["injected"].values()) >= 1  # the mix fired
+    assert mgr_snap["re_dispatches"] >= 1
+    # the ladder is the declared dp-halving chain, faults or not
+    assert mgr_snap["ladder"] == [[4, 2], [2, 2], [1, 2]]
+
+
+def test_mesh_fault_drill_is_deterministic():
+    a_sigs, _, a_mgr, a_plan = run_mesh_fault_drill(SEED)
+    b_sigs, _, b_mgr, b_plan = run_mesh_fault_drill(SEED)
+    assert a_sigs == b_sigs
+    assert a_plan == b_plan
+    for key in ("downsizes", "re_dispatches", "current_shape", "epoch"):
+        assert a_mgr[key] == b_mgr[key], key

@@ -35,6 +35,7 @@ re-shard, never at module scope.
 
 from __future__ import annotations
 
+import contextlib
 import random
 import threading
 import time
@@ -213,6 +214,60 @@ class DeviceFaultPlan:
         }
 
 
+class _ShapeGate:
+    """Reader-writer gate serializing mesh shape transitions with device
+    dispatches.
+
+    The batcher's dispatch executor runs ``pipeline_depth`` worker
+    threads (2 by default), so "run the re-shard on the executor" does
+    NOT serialize it with dispatches — a second worker can be mid-PJRT
+    on the old params while ``shard_embedder_mesh`` mutates them.  Every
+    dispatch therefore holds the *shared* side for the duration of its
+    device call, and ``downsize``/``try_recover`` hold the *exclusive*
+    side across the re-shard: a shape change waits out in-flight
+    dispatches, and dispatches never observe a torn embedder.  Writer
+    preference (a waiting writer blocks new readers) bounds the wait to
+    the dispatches already in flight.
+    """
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    @contextlib.contextmanager
+    def shared(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if not self._readers:
+                    self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def exclusive(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()
+
+
 class _Rung:
     """One ladder step: the shape plus the Mesh built at warmup.
 
@@ -236,11 +291,15 @@ class MeshFaultManager:
     """The mesh fault-domain brain: classify → downsize → re-dispatch →
     probe → upsize.
 
-    Thread-safety: ``classify``/``note_*``/``snapshot`` run under a lock
-    (dispatch executor thread + event loop both call in).  ``downsize``
-    and ``try_recover`` mutate the embedder and therefore must run ON
-    the batcher's single-thread dispatch executor, which serializes them
-    with real dispatches — the batcher wires that.
+    Thread-safety: ``classify``/``note_*``/``snapshot`` and the state
+    properties run under a lock (dispatch executor threads + event loop
+    all call in).  ``downsize`` and ``try_recover`` mutate the embedder,
+    which the dispatch threads read mid-PJRT-call; they take the
+    exclusive side of the shape gate (``_ShapeGate``) across the
+    re-shard while every dispatch holds the shared side
+    (``dispatch_guard``, wired in the batcher's ``_dispatch``), so a
+    shape change drains in-flight dispatches first no matter which
+    thread runs it.
     """
 
     def __init__(
@@ -266,7 +325,10 @@ class MeshFaultManager:
         # AFTER the upsize re-shard; a device-classified raise rolls the
         # upsize back
         self.probe_fn = None
-        self._lock = threading.Lock()
+        # re-entrant: snapshot() reads the locked state properties while
+        # already holding the lock
+        self._lock = threading.RLock()
+        self._shape_gate = _ShapeGate()
         self._rungs: List[_Rung] = []
         self._rung_index = 0
         self._epoch = 0
@@ -274,6 +336,8 @@ class MeshFaultManager:
         self._upsizes = 0
         self._re_dispatches = 0
         self._probe_failures = 0
+        self._consecutive_probe_failures = 0
+        self._warned_blind_upsize = False
         self._transient_streak = 0
         self._watchdog_overdue = False
         self._faulted_devices: list = []
@@ -322,12 +386,25 @@ class MeshFaultManager:
 
         self.build_ladder()
         timings = []
-        for rung in reversed(self._rungs):
-            shard_embedder_mesh(self.embedder, rung.mesh)
-            timings.extend(
-                self.embedder.aot_warmup(specs, r_buckets, packed_buckets)
-            )
+        with self._shape_gate.exclusive():
+            for rung in reversed(self._rungs):
+                shard_embedder_mesh(self.embedder, rung.mesh)
+                timings.extend(
+                    self.embedder.aot_warmup(
+                        specs, r_buckets, packed_buckets
+                    )
+                )
         return timings
+
+    # -- dispatch/transition serialization ------------------------------------
+
+    def dispatch_guard(self):
+        """Shared-side context for one device dispatch: the batcher's
+        ``_dispatch`` holds this across the embedder call, so
+        ``downsize``/``try_recover`` (exclusive side) wait out in-flight
+        dispatches before re-sharding instead of tearing the params a
+        concurrent executor thread is reading."""
+        return self._shape_gate.shared()
 
     # -- classification -------------------------------------------------------
 
@@ -401,31 +478,35 @@ class MeshFaultManager:
 
     @property
     def degraded(self) -> bool:
-        return self._rung_index > 0
+        with self._lock:
+            return self._rung_index > 0
 
     @property
     def exhausted(self) -> bool:
         """Past the last rung: every fallback shape is spent and the
         CPU twin (DEVICE_WATCHDOG_CPU_FALLBACK) is the only lever left."""
-        return self._rung_index >= len(self._rungs) - 1
+        with self._lock:
+            return self._rung_index >= len(self._rungs) - 1
 
     @property
     def epoch(self) -> int:
-        return self._epoch
+        with self._lock:
+            return self._epoch
 
     @property
     def current_shape(self) -> tuple:
-        if not self._rungs:
-            return self.full_shape
-        rung = self._rungs[self._rung_index]
-        return (rung.dp, rung.tp)
+        with self._lock:
+            if not self._rungs:
+                return self.full_shape
+            rung = self._rungs[self._rung_index]
+            return (rung.dp, rung.tp)
 
     def _rescale(self) -> None:
         scale = self.current_shape[0] / self.full_shape[0]
         for hook in self.rescale_hooks:
             hook(scale)
 
-    def downsize(self) -> bool:
+    def downsize(self, observed_epoch: Optional[int] = None) -> bool:
         """Step down one ladder rung: re-shard params onto the stored
         surviving submesh (the executable-table swap is implicit —
         dispatch keys follow ``embedder.mesh_shape``), record the
@@ -433,29 +514,45 @@ class MeshFaultManager:
         rescale admission/batcher capacity.  Returns False when the
         ladder is exhausted (caller falls back to the CPU twin).
 
-        MUST run on the batcher's dispatch executor: it mutates the
-        embedder the dispatch thread reads.
+        ``observed_epoch`` is the mesh epoch the failed dispatch was
+        stamped with at launch.  Pipelined dispatches can fault on the
+        SAME dead device concurrently; only the first fault per epoch
+        may step the ladder — a stale epoch means the shape already
+        changed since this dispatch launched, so the fault is old news
+        and the caller should just re-queue onto the current shape
+        (returns True without stepping).
+
+        Runs under the exclusive side of the shape gate: the re-shard
+        waits out in-flight dispatches (which hold the shared side), so
+        it is safe from any thread — including the multi-worker dispatch
+        executor.
         """
         from ..parallel.sharding import shard_embedder_mesh
 
         self.build_ladder()
-        with self._lock:
-            if self._rung_index >= len(self._rungs) - 1:
-                return False
-            old = self._rungs[self._rung_index]
-            self._rung_index += 1
-            rung = self._rungs[self._rung_index]
-            dropped = [
-                d for d in old.devices if d not in rung.devices
-            ]
-            self._faulted_devices.extend(
-                getattr(d, "id", d) for d in dropped
-            )
-            self._downsizes += 1
-            self._epoch += 1
-            self._transient_streak = 0
-            self._watchdog_overdue = False
-        shard_embedder_mesh(self.embedder, rung.mesh)
+        with self._shape_gate.exclusive():
+            with self._lock:
+                if (
+                    observed_epoch is not None
+                    and observed_epoch != self._epoch
+                ):
+                    return True
+                if self._rung_index >= len(self._rungs) - 1:
+                    return False
+                old = self._rungs[self._rung_index]
+                self._rung_index += 1
+                rung = self._rungs[self._rung_index]
+                dropped = [
+                    d for d in old.devices if d not in rung.devices
+                ]
+                self._faulted_devices.extend(
+                    getattr(d, "id", d) for d in dropped
+                )
+                self._downsizes += 1
+                self._epoch += 1
+                self._transient_streak = 0
+                self._watchdog_overdue = False
+            shard_embedder_mesh(self.embedder, rung.mesh)
         self._rescale()
         return True
 
@@ -463,9 +560,13 @@ class MeshFaultManager:
         """The recovery probe: while degraded, re-validate the full mesh
         and upsize back.  A ``DeviceFaultPlan`` draw models the probe
         dispatch (a still-faulty plan keeps the mesh down); with a real
-        ``probe_fn`` attached, the upsize re-shard happens first and a
-        device-classified raise rolls it back.  MUST run on the dispatch
-        executor, like ``downsize``.
+        ``probe_fn`` attached (serve/__main__.py wires a warmed-bucket
+        full-mesh dispatch), the upsize re-shard happens first and a
+        device-classified raise rolls it back.  With NEITHER, the upsize
+        is unvalidated — a still-dead device faults the next dispatch
+        and the mesh flaps down again — so that mode logs a one-time
+        warning.  Holds the exclusive side of the shape gate across the
+        re-shard + probe + possible rollback, like ``downsize``.
         """
         from ..parallel.sharding import shard_embedder_mesh
 
@@ -477,32 +578,62 @@ class MeshFaultManager:
             if fault is not None:
                 with self._lock:
                     self._probe_failures += 1
+                    self._consecutive_probe_failures += 1
                 return False
-        prev_index = self._rung_index
-        full = self._rungs[0]
-        shard_embedder_mesh(self.embedder, full.mesh)
-        if self.probe_fn is not None:
-            try:
-                self.probe_fn()
-            except Exception as exc:
-                if classify_dispatch_error(exc) is None:
-                    raise
-                shard_embedder_mesh(
-                    self.embedder, self._rungs[prev_index].mesh
-                )
-                with self._lock:
-                    self._probe_failures += 1
-                self._rescale()
-                return False
-        with self._lock:
-            self._rung_index = 0
-            self._upsizes += 1
-            self._epoch += 1
-            self._faulted_devices.clear()
-            self._transient_streak = 0
-            self._watchdog_overdue = False
+        elif self.probe_fn is None and not self._warned_blind_upsize:
+            self._warned_blind_upsize = True
+            import logging
+
+            logging.getLogger("lwc.resilience").warning(
+                "mesh fault recovery has no probe_fn and no "
+                "DEVICE_FAULT_PLAN: upsizing to the full mesh without "
+                "validating it — a still-dead device will fault the next "
+                "dispatch and downsize again (attach probe_fn, as "
+                "serve/__main__.py does, to validate before upsizing)"
+            )
+        with self._shape_gate.exclusive():
+            with self._lock:
+                prev_index = self._rung_index
+            full = self._rungs[0]
+            shard_embedder_mesh(self.embedder, full.mesh)
+            if self.probe_fn is not None:
+                try:
+                    self.probe_fn()
+                except Exception as exc:
+                    # roll back FIRST either way: the manager still
+                    # reports the surviving rung, so the embedder must
+                    # not be left sharded at the full shape
+                    shard_embedder_mesh(
+                        self.embedder, self._rungs[prev_index].mesh
+                    )
+                    if classify_dispatch_error(exc) is None:
+                        raise  # probe bug, not a device fault
+                    with self._lock:
+                        self._probe_failures += 1
+                        self._consecutive_probe_failures += 1
+                    self._rescale()
+                    return False
+            with self._lock:
+                self._rung_index = 0
+                self._upsizes += 1
+                self._epoch += 1
+                self._faulted_devices.clear()
+                self._consecutive_probe_failures = 0
+                self._transient_streak = 0
+                self._watchdog_overdue = False
         self._rescale()
         return True
+
+    def probe_backoff_scale(self, cap: float = 32.0) -> float:
+        """Multiplier for the prober's sleep between attempts: doubles
+        per consecutive probe failure (capped) so a long-dead device is
+        probed ever more lazily — each failed probe re-shards and
+        rolls back, work worth not repeating every interval — and resets
+        to 1 on a successful upsize."""
+        with self._lock:
+            return float(
+                min(2.0 ** self._consecutive_probe_failures, cap)
+            )
 
     # -- observability ---------------------------------------------------------
 
@@ -518,6 +649,7 @@ class MeshFaultManager:
                 "upsizes": self._upsizes,
                 "re_dispatches": self._re_dispatches,
                 "probe_failures": self._probe_failures,
+                "probe_backoff": self.probe_backoff_scale(),
                 "faulted_devices": list(self._faulted_devices),
                 "ladder": [[r.dp, r.tp] for r in self._rungs],
             }

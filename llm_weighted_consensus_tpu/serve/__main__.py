@@ -839,6 +839,34 @@ def build_service(
                 _mf_log.info(
                     "mesh fault ladder AOT %s compiled in %.1fs", label, dt
                 )
+        if config.mesh_fault_probe_millis > 0:
+            # real recovery validation: try_recover re-shards to the
+            # full mesh and runs this tiny dispatch across it BEFORE
+            # reporting recovered; a device-classified raise rolls the
+            # upsize back.  Without it (and without a fault plan) the
+            # prober would blindly upsize and flap down again on the
+            # next real dispatch.  Uses the first warmed consensus spec
+            # so in a warmed service the probe hits an AOT executable.
+            import numpy as _np
+
+            from ..models.embedder import _seq_bucket
+
+            if config.warmup:
+                _pn, _ps = config.warmup[0]
+                _probe_shape = (_pn, _seq_bucket(_ps, embedder.max_tokens))
+            else:
+                _probe_shape = (2, _seq_bucket(8, embedder.max_tokens))
+
+            def _mesh_probe(shape=_probe_shape):
+                n, s = shape
+                ids = _np.zeros((n, s), dtype=_np.int32)
+                mask = _np.zeros((n, s), dtype=_np.int32)
+                mask[:, 0] = 1  # one real token per row: a clean forward
+                _np.asarray(
+                    embedder.consensus_confidence_tokens(ids, mask)
+                )
+
+            meshfault.probe_fn = _mesh_probe
     reranker = build_reranker(config, allow_synthetic=fake_upstream)
     from .metrics import Metrics
 
@@ -1164,9 +1192,13 @@ def build_service(
         and batcher is not None
     ):
         # recovery prober (MESH_FAULT_PROBE_MILLIS > 0): while degraded,
-        # periodically re-validate the full mesh and upsize back.  The
-        # probe runs on the batcher's dispatch executor, which serializes
-        # the upsize re-shard with in-flight dispatches.
+        # periodically re-validate the full mesh (probe_fn above: a real
+        # full-mesh dispatch) and upsize back.  try_recover holds the
+        # shape gate's exclusive side across the re-shard + probe, so it
+        # is serialized with in-flight dispatches regardless of which
+        # executor thread runs it; repeated probe failures back off
+        # exponentially (each failed probe is a re-shard + rollback —
+        # work worth not repeating every interval against a dead chip).
         probe_sec = config.mesh_fault_probe_millis / 1e3
         prober_tasks: list = []
 
@@ -1175,7 +1207,9 @@ def build_service(
 
             async def _probe_loop():
                 while True:
-                    await asyncio.sleep(probe_sec)
+                    await asyncio.sleep(
+                        probe_sec * meshfault.probe_backoff_scale()
+                    )
                     if meshfault.degraded:
                         await loop.run_in_executor(
                             batcher._executor, meshfault.try_recover

@@ -36,6 +36,7 @@ metrics provider hook so the window/batch knobs are tunable from
 from __future__ import annotations
 
 import asyncio
+import functools
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -155,6 +156,7 @@ class DeviceBatcher:
         self.meshfault = meshfault
         self.shed_queue_full = 0
         self.shed_deadline = 0
+        self.shed_redispatch_limit = 0
         self.cancelled_items = 0
         self.fallback_dispatches = 0
         # per-kind EWMA of dispatch wall time: the deadline shed drops
@@ -426,6 +428,7 @@ class DeviceBatcher:
             "max_queue_depth": self.max_queue_depth,
             "shed_queue_full": self.shed_queue_full,
             "shed_deadline": self.shed_deadline,
+            "shed_redispatch_limit": self.shed_redispatch_limit,
             "cancelled_items": self.cancelled_items,
             "fallback_active": self._use_fallback,
             "fallback_dispatches": self.fallback_dispatches,
@@ -679,12 +682,12 @@ class DeviceBatcher:
         # bracketing exactly what the watchdog brackets (the executor
         # hop + the PJRT call); the mesh epoch stamps which shape served
         # the dispatch, so a re-dispatched item's span tree shows one
-        # child per epoch it touched
-        extra = (
-            {"mesh_epoch": self.meshfault.epoch}
-            if self.meshfault is not None
-            else {}
-        )
+        # child per epoch it touched — and a classified fault hands the
+        # SAME stamp to downsize(), which skips the ladder step when the
+        # epoch already advanced (two pipelined groups faulting on one
+        # dead device must cost one rung, not two)
+        epoch = self.meshfault.epoch if self.meshfault is not None else None
+        extra = {"mesh_epoch": epoch} if epoch is not None else {}
         dspans = [
             item.span.child(
                 "device:dispatch",
@@ -718,7 +721,7 @@ class DeviceBatcher:
                 else None
             )
             if kind is not None:
-                await self._handle_device_fault(loop, kind, e, group)
+                await self._handle_device_fault(loop, kind, e, group, epoch)
             else:
                 for item in group:
                     if not item.future.done():
@@ -742,10 +745,14 @@ class DeviceBatcher:
     # can never recycle one item indefinitely
     REDISPATCH_LIMIT = 8
 
-    async def _handle_device_fault(self, loop, kind, exc, group) -> None:
+    async def _handle_device_fault(
+        self, loop, kind, exc, group, epoch=None
+    ) -> None:
         """React to a classified device fault: persistent faults walk
-        the downsize ladder (on the dispatch executor, which serializes
-        the embedder re-shard with real dispatches); a spent ladder
+        the downsize ladder (off the event loop — the downsize blocks on
+        the shape gate until in-flight dispatches drain, and holds the
+        failed dispatch's launch epoch so concurrent faults from one
+        dead device step the ladder exactly once); a spent ladder
         flips to the CPU twin — the last resort, per the
         DEVICE_WATCHDOG_CPU_FALLBACK x MESH_ENABLED precedence — and a
         spent ladder WITHOUT a twin fails the group.  Every surviving
@@ -753,7 +760,10 @@ class DeviceBatcher:
         new (or retried) shape."""
         if kind == "persistent":
             ok = await loop.run_in_executor(
-                self._executor, self.meshfault.downsize
+                self._executor,
+                functools.partial(
+                    self.meshfault.downsize, observed_epoch=epoch
+                ),
             )
             if not ok:
                 if self.fallback_embedder is not None:
@@ -790,7 +800,17 @@ class DeviceBatcher:
                     )
                 continue
             if item.redispatches >= self.REDISPATCH_LIMIT:
+                # observable like the adjacent deadline shed: a fault
+                # loop exhausting items must show up in /metrics, not
+                # only as client-side errors
+                if item.span is not None:
+                    item.span.annotate(shed="redispatch_limit")
                 item.future.set_exception(exc)
+                self.shed_redispatch_limit += 1
+                if self.metrics is not None:
+                    self.metrics.observe(
+                        "device:shed:redispatch", 0.0, error=True
+                    )
                 continue
             item.redispatches += 1
             live.append(item)
@@ -928,14 +948,20 @@ class DeviceBatcher:
                     return fn(group, self.fallback_embedder)
             return fn(group, self.fallback_embedder)
         if self.meshfault is not None:
-            # the DEVICE_FAULT_PLAN seam, on the dispatch thread where
-            # a real device failure would raise; the CPU-twin branch
-            # above never injects (the plan models the device tier)
-            self.meshfault.maybe_inject()
-        results = fn(group, self.embedder)
-        if self.meshfault is not None:
+            # shared side of the shape gate: this dispatch's embedder
+            # reads are serialized against downsize/try_recover re-shards
+            # (the executor has pipeline_depth workers, so "run the
+            # re-shard on the executor" alone would NOT serialize them).
+            # The DEVICE_FAULT_PLAN seam injects here, on the dispatch
+            # thread where a real device failure would raise; the
+            # CPU-twin branch above never injects (the plan models the
+            # device tier)
+            with self.meshfault.dispatch_guard():
+                self.meshfault.maybe_inject()
+                results = fn(group, self.embedder)
             self.meshfault.note_dispatch_ok()
-        return results
+            return results
+        return fn(group, self.embedder)
 
     def _dispatch_embed(self, group: list, embedder) -> list:
         max_tokens = group[0].payload[1]

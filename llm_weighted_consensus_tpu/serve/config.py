@@ -266,10 +266,11 @@ all opt-in — unset keeps the PR 9 mesh path byte-for-byte):
   before the streak escalates to persistent and walks the ladder.
   Default 2.
 * ``MESH_FAULT_PROBE_MILLIS`` — recovery-prober period: while
-  degraded, every interval the full mesh is re-validated and, when
-  healthy, the mesh upsizes back to the full shape (capacity restored,
-  ``degraded_mesh`` clears).  ``0`` (the default) disables automatic
-  recovery.
+  degraded, every interval the full mesh is re-validated with a real
+  probe dispatch (a failed probe rolls the upsize back and backs the
+  interval off exponentially) and, when healthy, the mesh upsizes back
+  to the full shape (capacity restored, ``degraded_mesh`` clears).
+  ``0`` (the default) disables automatic recovery.
 * ``DEVICE_FAULT_PLAN`` — deterministic device-fault injection at the
   dispatch seam (the ``FAULT_PLAN`` contract at the embedder boundary),
   e.g. ``seed=42,persistent=0.05`` or

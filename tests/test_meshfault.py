@@ -26,6 +26,8 @@ What this pins:
 """
 
 import asyncio
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -246,6 +248,101 @@ def test_downsized_rung_serves_warmed_zero_new_specializations():
     assert emb.jit_stats()["specializations"] == stats0
 
 
+# -- shape-transition serialization -------------------------------------------
+
+
+def test_shape_transition_waits_for_inflight_dispatch():
+    """The shape gate: downsize() must drain in-flight dispatches before
+    re-sharding — the batcher's executor has pipeline_depth (default 2)
+    workers, so a concurrent dispatch thread can be mid-PJRT on the old
+    params when the fault handler runs."""
+    emb = mesh_embedder()
+    mgr = manager_for(emb)
+    dispatching = threading.Event()
+    finish_dispatch = threading.Event()
+    order = []
+
+    def dispatch_thread():
+        with mgr.dispatch_guard():
+            dispatching.set()
+            finish_dispatch.wait(5.0)
+            order.append("dispatch")
+
+    def downsize_thread():
+        mgr.downsize()
+        order.append("downsize")
+
+    t = threading.Thread(target=dispatch_thread)
+    t.start()
+    assert dispatching.wait(5.0)
+    w = threading.Thread(target=downsize_thread)
+    w.start()
+    time.sleep(0.05)
+    # the re-shard is parked behind the in-flight dispatch
+    assert order == []
+    assert mgr.current_shape == (DP, TP)
+    finish_dispatch.set()
+    t.join(5.0)
+    w.join(5.0)
+    assert order == ["dispatch", "downsize"]
+    assert mgr.current_shape == (2, 2)
+
+
+def test_stale_epoch_fault_skips_ladder_step():
+    """Pipelined groups faulting on the SAME dead device must cost one
+    rung: a downsize carrying a pre-transition epoch stamp re-queues
+    without stepping the ladder again."""
+    mgr = manager_for(mesh_embedder())
+    epoch0 = mgr.epoch
+    assert mgr.downsize(observed_epoch=epoch0) is True
+    assert mgr.current_shape == (2, 2)
+    # the second in-flight group observed the same pre-downsize epoch:
+    # its fault is old news — True (re-queue) but no rung spent
+    assert mgr.downsize(observed_epoch=epoch0) is True
+    assert mgr.current_shape == (2, 2)
+    snap = mgr.snapshot()
+    assert snap["downsizes"] == 1
+    assert snap["epoch"] == 1
+
+
+def test_concurrent_persistent_faults_downsize_once_through_batcher():
+    """End to end: two pipelined dispatch groups both drawing persistent
+    faults from one fault event step the ladder exactly once, and every
+    re-dispatched answer still matches the fault-free run."""
+    ref = make_embedder()
+    emb = mesh_embedder()
+    mgr = manager_for(
+        emb,
+        fault_plan=DeviceFaultPlan.scripted(["persistent", "persistent"]),
+    )
+    mgr.warm_ladder([(N, S)], [R])
+    batcher = DeviceBatcher(
+        emb, Metrics(), window_ms=20.0, pipeline_depth=2, meshfault=mgr
+    )
+    short = TEXTS[:4]
+
+    async def run():
+        # different candidate counts -> different keys -> two groups,
+        # dispatched concurrently on the 2-deep pipeline; both draw a
+        # persistent fault before either handler can downsize
+        return await asyncio.gather(
+            batcher.consensus(TEXTS), batcher.consensus(short)
+        )
+
+    (conf_a, _), (conf_b, _) = go(run())
+    np.testing.assert_allclose(
+        conf_a, np.asarray(ref.consensus_confidence(TEXTS)), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        conf_b, np.asarray(ref.consensus_confidence(short)), atol=1e-5
+    )
+    snap = mgr.snapshot()
+    # ONE fault event, one rung — not one per in-flight group
+    assert snap["downsizes"] == 1
+    assert snap["current_shape"] == [2, 2]
+    assert snap["re_dispatches"] >= 2
+
+
 # -- re-dispatch through the batcher ------------------------------------------
 
 
@@ -329,6 +426,37 @@ def test_redispatch_sheds_expired_deadline_as_504():
     assert (
         metrics.snapshot()["series"]["device:shed:deadline"]["errors"] == 1
     )
+
+
+def test_redispatch_limit_is_observable_in_metrics():
+    """An item failed at REDISPATCH_LIMIT must show in /metrics like the
+    adjacent deadline shed — a fault loop exhausting items cannot be
+    invisible."""
+    emb = mesh_embedder()
+    # transient_retries high enough that the streak never escalates to
+    # persistent: every fault re-queues on the same shape until the
+    # per-item limit trips
+    mgr = manager_for(
+        emb,
+        transient_retries=100,
+        fault_plan=DeviceFaultPlan.scripted(
+            ["transient"] * (DeviceBatcher.REDISPATCH_LIMIT + 1)
+        ),
+    )
+    metrics = Metrics()
+    batcher = DeviceBatcher(emb, metrics, window_ms=5.0, meshfault=mgr)
+
+    async def run():
+        with pytest.raises(InjectedTransientError):
+            await batcher.embed(["recycled until the limit"])
+
+    go(run())
+    assert batcher.shed_redispatch_limit == 1
+    assert (
+        metrics.snapshot()["series"]["device:shed:redispatch"]["errors"]
+        == 1
+    )
+    assert mgr.snapshot()["re_dispatches"] == DeviceBatcher.REDISPATCH_LIMIT
 
 
 def test_application_errors_keep_fail_the_group_path():
@@ -476,6 +604,43 @@ def test_probe_fn_failure_rolls_back_upsize():
     assert mgr.degraded
     assert emb.mesh_shape == (2, 2)  # rolled back to the surviving rung
     assert mgr.snapshot()["probe_failures"] == 1
+
+
+def test_probe_backoff_scales_with_failures_and_resets():
+    emb = mesh_embedder()
+    mgr = manager_for(
+        emb,
+        fault_plan=DeviceFaultPlan.scripted(
+            ["persistent", "persistent", None]
+        ),
+    )
+    assert mgr.downsize() is True  # consumes no plan draws
+    assert mgr.probe_backoff_scale() == 1.0
+    assert mgr.try_recover() is False  # probe draw #1 faults
+    assert mgr.probe_backoff_scale() == 2.0
+    assert mgr.try_recover() is False  # probe draw #2 faults
+    assert mgr.probe_backoff_scale() == 4.0
+    assert mgr.snapshot()["probe_backoff"] == 4.0
+    assert mgr.try_recover() is True  # healthy: upsize resets backoff
+    assert mgr.probe_backoff_scale() == 1.0
+
+
+def test_blind_upsize_warns_once(caplog):
+    """No probe_fn and no fault plan: the upsize is unvalidated, which
+    deserves a loud (but one-time) warning — production wires a real
+    probe_fn in serve/__main__.py."""
+    import logging
+
+    mgr = manager_for(mesh_embedder())
+    assert mgr.downsize() is True
+    with caplog.at_level(logging.WARNING, logger="lwc.resilience"):
+        assert mgr.try_recover() is True
+        assert mgr.downsize() is True
+        assert mgr.try_recover() is True
+    warnings = [
+        r for r in caplog.records if "no probe_fn" in r.getMessage()
+    ]
+    assert len(warnings) == 1
 
 
 def test_not_degraded_try_recover_is_noop():

@@ -98,6 +98,25 @@ def make_requests(n_requests: int, n_candidates: int, seed: int = 0) -> list:
     return requests
 
 
+def phase_summary() -> dict:
+    """The phase-breakdown block every BENCH record embeds (ISSUE 11):
+    per-phase p50/p99 from the process-global aggregator plus the device
+    share of attributed time.  Harnesses call ``reset_phases()`` right
+    before their timed window so the summary covers exactly it."""
+    from llm_weighted_consensus_tpu.obs import phases_snapshot
+
+    snap = phases_snapshot()
+    phases = {
+        phase: {"p50_ms": row["p50_ms"], "p99_ms": row["p99_ms"]}
+        for phase, row in snap.items()
+        if isinstance(row, dict) and row.get("count")
+    }
+    return {
+        "phases": phases,
+        "device_time_share": snap.get("device_time_share"),
+    }
+
+
 def bench_tokenizer():
     """A WordPiece tokenizer (native C++ ASCII fast path when built)
     covering the bench word list — the deployment-shaped host path, and

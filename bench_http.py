@@ -93,10 +93,15 @@ from bench import (  # noqa: E402
     BENCH_WORDS,
     bench_tokenizer,
     make_requests,
+    phase_summary,
 )
 
 
 def emit(endpoint: str, value: float, unit: str, **extra) -> None:
+    # every record carries the phase attribution of its timed window
+    # (the service runs in-process, so the global aggregator — reset by
+    # _drive after warmup — covers exactly the measured traffic)
+    extra.setdefault("phase_breakdown", phase_summary())
     print(
         json.dumps(
             {
@@ -212,6 +217,11 @@ async def _drive(session, url, bodies, concurrency, warmup_bursts=2):
     for _ in range(warmup_bursts):
         burst = (bodies * ((concurrency // len(bodies)) + 1))[:concurrency]
         await asyncio.gather(*(one(b, record=False) for b in burst))
+    # scope the phase aggregator to the timed window (the summary every
+    # emitted record embeds via bench.phase_summary)
+    from llm_weighted_consensus_tpu.obs import reset_phases
+
+    reset_phases()
     t0 = time.perf_counter()
     await asyncio.gather(*(one(b) for b in bodies))
     return time.perf_counter() - t0, lat

@@ -70,7 +70,13 @@ def run_closed_loop() -> dict:
     import jax
     import numpy as np
 
-    from bench import BASELINE_BASIS, bench_tokenizer, make_requests
+    from bench import (
+        BASELINE_BASIS,
+        bench_tokenizer,
+        make_requests,
+        phase_summary,
+    )
+    from llm_weighted_consensus_tpu.obs import reset_phases
     from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
     from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
     from llm_weighted_consensus_tpu.parallel.sharding import (
@@ -148,6 +154,7 @@ def run_closed_loop() -> dict:
         batcher = DeviceBatcher(embedder, metrics, window_ms=3.0)
         confs = closed_loop(batcher)  # untimed: absorbs first-touch
         spec_before = embedder.jit_stats()["specializations"]
+        reset_phases()  # scope the phase summary to the timed pass
         t0 = time.perf_counter()
         confs = closed_loop(batcher)
         elapsed = time.perf_counter() - t0
@@ -170,6 +177,9 @@ def run_closed_loop() -> dict:
             "dispatches_per_request": round(per_request, 4),
             "aot_buckets": embedder.jit_stats()["aot_buckets"],
             "matches_single_device": True,
+            # per-dp phase attribution of the timed pass (per-bucket
+            # device time lands under its @dp{dp}xtp1 label)
+            "phase_breakdown": phase_summary(),
         }
         rows.append(row)
         print(json.dumps(row), flush=True)

@@ -46,6 +46,11 @@ TPU chip is rented:
   a mid-incident downsize compiles under fire), and driving the public
   dispatch on each downsized rung must pass the JXA011 parity gate
   against the single-device reference with zero specialization growth.
+* **JXA013 roofline coverage** — every audited bucket must have a
+  live row in ``analysis/roofline.json`` (flops / bytes-accessed plus
+  per-chip backend peaks) so the serving gauge can report speed-of-light
+  attainment; missing rows, stale rows, drifted figures, and bad peaks
+  all fail (``roofline.py``).
 
 Device plumbing: the checks need ``dp*tp`` devices.  Under tier-1
 pytest the conftest already forces 8 virtual CPU devices, so everything
@@ -58,12 +63,14 @@ default ``test-tiny``), ``ANALYSIS_MESH_DP`` / ``ANALYSIS_MESH_TP``
 (mesh shape, default 4×2), ``ANALYSIS_MESH_SPECS`` (``NxS`` list,
 default ``8x16``), ``ANALYSIS_MESH_R_BUCKETS`` (default ``2``),
 ``ANALYSIS_MESH_PACKED_BUCKETS`` (``BxLxK`` list, default ``8x64x8``),
-``ANALYSIS_BUDGETS`` (budgets file override), ``ANALYSIS_SKIP_MESH=1``
+``ANALYSIS_BUDGETS`` (budgets file override), ``ANALYSIS_ROOFLINE``
+(roofline file override), ``ANALYSIS_SKIP_MESH=1``
 to skip (honored by the CLI and scripts/t1.sh; tier-1 does not set it).
 
 Re-baselining: ``python -m llm_weighted_consensus_tpu.analysis.mesh_audit
 --write-budgets`` re-measures and rewrites ``budgets.json`` (tolerance,
-threshold, and allowlist preserved); review the diff.
+threshold, and allowlist preserved); ``--write-roofline`` does the same
+for ``roofline.json`` (peaks and tolerance preserved); review the diff.
 """
 
 from __future__ import annotations
@@ -85,6 +92,12 @@ from .budgets import (
     replicated_threshold,
 )
 from .engine import Finding
+from .roofline import (
+    compare_roofline,
+    default_roofline_path,
+    load_roofline,
+    write_roofline,
+)
 
 _DEFAULT_MODEL = "test-tiny"
 _DEFAULT_RM_MODEL = "deberta-test-tiny"
@@ -153,6 +166,11 @@ def _env_packed_buckets() -> Tuple[Tuple[int, int, int], ...]:
 def _budgets_path() -> Path:
     raw = os.environ.get("ANALYSIS_BUDGETS", "")
     return Path(raw) if raw.strip() else default_budgets_path()
+
+
+def _roofline_path() -> Path:
+    raw = os.environ.get("ANALYSIS_ROOFLINE", "")
+    return Path(raw) if raw.strip() else default_roofline_path()
 
 
 def _scope() -> dict:
@@ -832,7 +850,9 @@ def _devices_ok(need: int) -> bool:
     return jax.device_count() >= need
 
 
-def _respawn(need: int, write_budgets: bool) -> List[Finding]:
+def _respawn(
+    need: int, write_budgets: bool, write_roofline: bool = False
+) -> List[Finding]:
     """Re-run this module in a child with ``need`` virtual CPU devices
     (the parent's jax backend, if initialized, is stuck at its device
     count — XLA_FLAGS are read once at first backend init)."""
@@ -846,6 +866,8 @@ def _respawn(need: int, write_budgets: bool) -> List[Finding]:
     ]
     if write_budgets:
         cmd.append("--write-budgets")
+    if write_roofline:
+        cmd.append("--write-roofline")
     env = force_cpu_env(dict(os.environ), n_devices=need)
     proc = subprocess.run(
         cmd, capture_output=True, text=True, env=env, timeout=600
@@ -870,6 +892,7 @@ def _respawn(need: int, write_budgets: bool) -> List[Finding]:
 
 def _audit_in_process(
     write_budgets: bool = False,
+    write_roofline_file: bool = False,
 ) -> Tuple[List[Finding], Dict[str, Dict[str, float]]]:
     findings: List[Finding] = []
     budgets_path = _budgets_path()
@@ -902,6 +925,15 @@ def _audit_in_process(
         _write_budgets_file(budgets_path, measured, budgets)
     else:
         findings += compare_budgets(measured, budgets, scope=_scope())
+    # JXA013: the same measured cost figures must back a committed
+    # roofline row per bucket, or serving would run without its
+    # speed-of-light attainment gauge.
+    roofline_path = _roofline_path()
+    roofline = load_roofline(roofline_path)
+    if write_roofline_file:
+        write_roofline(roofline_path, measured, _scope(), roofline)
+    else:
+        findings += compare_roofline(measured, roofline, scope=_scope())
     return findings, measured
 
 
@@ -935,14 +967,16 @@ def _write_budgets_file(
     path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
 
 
-def run_mesh_audit(write_budgets: bool = False) -> List[Finding]:
+def run_mesh_audit(
+    write_budgets: bool = False, write_roofline: bool = False
+) -> List[Finding]:
     """Entry point for the analysis CLI and tier-1: in-process when the
     backend already has dp*tp devices (pytest's virtual-CPU env),
     subprocess respawn otherwise."""
     dp, tp = _env_mesh()
     if not _devices_ok(dp * tp):
-        return _respawn(dp * tp, write_budgets)
-    findings, _ = _audit_in_process(write_budgets)
+        return _respawn(dp * tp, write_budgets, write_roofline)
+    findings, _ = _audit_in_process(write_budgets, write_roofline)
     return findings
 
 
@@ -962,14 +996,22 @@ def main(argv=None) -> int:
         help="re-measure and rewrite analysis/budgets.json "
         "(policy knobs preserved); review the diff",
     )
+    parser.add_argument(
+        "--write-roofline",
+        action="store_true",
+        help="re-measure and rewrite analysis/roofline.json "
+        "(peaks and tolerance preserved); review the diff",
+    )
     args = parser.parse_args(argv)
 
     dp, tp = _env_mesh()
     if not _devices_ok(dp * tp):
-        findings = _respawn(dp * tp, args.write_budgets)
+        findings = _respawn(dp * tp, args.write_budgets, args.write_roofline)
         measured = {}
     else:
-        findings, measured = _audit_in_process(args.write_budgets)
+        findings, measured = _audit_in_process(
+            args.write_budgets, args.write_roofline
+        )
     if args.json:
         print(
             json.dumps(

@@ -40,6 +40,7 @@ from . import (  # noqa: E402
     lwc009_jax_in_async,
     lwc010_registry_consistency,
     lwc011_config_readme_drift,
+    lwc012_prom_family_registry,
 )
 
 ALL_RULES: Tuple[Rule, ...] = (
@@ -54,6 +55,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     lwc009_jax_in_async.RULE,
     lwc010_registry_consistency.RULE,
     lwc011_config_readme_drift.RULE,
+    lwc012_prom_family_registry.RULE,
 )
 
 RULES_BY_NAME = {rule.name: rule for rule in ALL_RULES}

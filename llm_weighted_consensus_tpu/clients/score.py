@@ -708,6 +708,7 @@ class ScoreClient:
         # the tally span's attributes are the consensus "explain" record:
         # per-judge vote/weight/contribution plus per-candidate results —
         # built only when a trace is live (None otherwise, zero cost)
+        t_tally = time.perf_counter()
         tspan = obs.child_span("consensus:tally", n_judges=len(model.llms))
 
         choice_weight = [Decimal(0)] * n_choices
@@ -819,6 +820,12 @@ class ScoreClient:
                 degraded=degraded,
             )
             tspan.finish()
+        # host_tally phase: the weighted-vote fold + final-frame build
+        # (runs with or without a live trace — the aggregate must not
+        # depend on sampling)
+        obs.observe_phase(
+            "host_tally", (time.perf_counter() - t_tally) * 1e3
+        )
         if degraded:
             # degraded consensus is always retained, whatever the sample
             # rate said at the door
@@ -890,11 +897,18 @@ class ScoreClient:
             weight=float(weight),
         )
         token = jspan.activate() if jspan is not None else None
+        t_judge = time.perf_counter()
         try:
             async for item in inner:
                 yield item
         finally:
             await inner.aclose()
+            # upstream_judge phase: this judge's whole ballot-stream
+            # lifetime (the per-request breakdown interval-unions the
+            # judge spans instead, so R concurrent judges count once)
+            obs.observe_phase(
+                "upstream_judge", (time.perf_counter() - t_judge) * 1e3
+            )
             if jspan is not None:
                 obs.Span.deactivate(token)
                 jspan.finish()

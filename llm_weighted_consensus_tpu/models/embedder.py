@@ -10,6 +10,7 @@ seeds ``weight_data`` cost, score client.rs:330-337).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Iterable, Optional
 
@@ -271,6 +272,11 @@ class TpuEmbedder:
         self.mesh_shape = None
         self.batch_sharding = None
         self.repl_sharding = None
+        # per-(mesh-shape, bucket) device timing at the dispatch seam
+        # (obs/phases.py; METRICS_DEVICE_TIMING=0 clears it): each timed
+        # dispatch blocks until ready, which the serving paths do anyway
+        # (they consume results on host immediately after)
+        self.device_timing = True
 
     # -- AOT bucket precompile ------------------------------------------------
 
@@ -309,6 +315,27 @@ class TpuEmbedder:
                 for a in arrays
             )
         return tuple(jnp.asarray(a) for a in arrays)
+
+    def _timed_dispatch(self, label: str, fn):
+        """Run one device dispatch under its canonical bucket label —
+        the SAME label the mesh audit measures and ``roofline.json``
+        keys, suffixed ``@dp{dp}xtp{tp}`` in mesh mode so the fault
+        ladder's rungs report separately — and record the
+        block-until-ready wall time into the global phase aggregator
+        (the ``device_dispatch`` phase + the roofline gauge's per-bucket
+        p50)."""
+        if not self.device_timing:
+            return fn()
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        if self.mesh_mode:
+            dp, tp = self.mesh_shape
+            label = f"{label}@dp{dp}xtp{tp}"
+        from ..obs import phases as _phases
+
+        _phases.observe_device(label, (time.perf_counter() - t0) * 1e3)
+        return out
 
     def _stage_temp(self, temperature):
         """The vote temperature as a device scalar (replicated over the
@@ -589,20 +616,27 @@ class TpuEmbedder:
             mask = np.pad(mask, ((0, pad_b - b), (0, 0)))
         if self.embed_override is not None:
             return np.asarray(self.embed_override(ids, mask)[:b])
+        label = f"embed(b={pad_b},s={ids.shape[1]})"
         exe = self._aot_lookup(
             self._aot_key(("embed", pad_b, ids.shape[1])), ids, mask
         )
         if exe is not None:
             dev_ids, dev_mask = self._stage_batch(ids, mask)
-            return np.asarray(exe(self.params, dev_ids, dev_mask)[:b])
+            emb = self._timed_dispatch(
+                label, lambda: exe(self.params, dev_ids, dev_mask)
+            )
+            return np.asarray(emb[:b])
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
-        emb = bert.embed(
-            self.params,
-            dev_ids,
-            dev_mask,
-            self.config,
-            pooling=self.pooling,
-            normalize=True,
+        emb = self._timed_dispatch(
+            label,
+            lambda: bert.embed(
+                self.params,
+                dev_ids,
+                dev_mask,
+                self.config,
+                pooling=self.pooling,
+                normalize=True,
+            ),
         )
         return np.asarray(emb[:b])
 
@@ -659,6 +693,7 @@ class TpuEmbedder:
                     np.asarray(seg_starts), ((0, pad), (0, 0))
                 )
         pb = ids.shape[0]
+        label = f"packed(b={pb},l={l},k={k})"
         exe = self._aot_lookup(
             self._aot_key(("packed", pb, l, k)), ids, segment_ids
         )
@@ -668,14 +703,19 @@ class TpuEmbedder:
             dev_ids, dev_segs, dev_pos, dev_starts = self._stage_batch(
                 ids, segment_ids, positions, seg_starts
             )
-            return np.asarray(
-                exe(self.params, dev_ids, dev_segs, dev_pos, dev_starts)
-            )[:b]
+            out = self._timed_dispatch(
+                label,
+                lambda: exe(
+                    self.params, dev_ids, dev_segs, dev_pos, dev_starts
+                ),
+            )
+            return np.asarray(out)[:b]
         dev_ids, dev_segs, dev_pos, dev_starts = self._stage_batch(
             ids, segment_ids, positions, seg_starts
         )
-        return np.asarray(
-            bert.embed_packed(
+        out = self._timed_dispatch(
+            label,
+            lambda: bert.embed_packed(
                 self.params,
                 dev_ids,
                 dev_segs,
@@ -684,8 +724,9 @@ class TpuEmbedder:
                 self.config,
                 pooling=self.pooling,
                 normalize=True,
-            )
-        )[:b]
+            ),
+        )
+        return np.asarray(out)[:b]
 
     def consensus_confidence(
         self,
@@ -714,6 +755,7 @@ class TpuEmbedder:
     ):
         n = ids.shape[0]
         ids, mask = self._pad_rows(ids, mask)
+        label = f"vote1(n={n},s={ids.shape[1]})"
         if self.mesh_mode:
             # one jit-with-shardings dispatch: encoder + the dp-sharded
             # vote reduction; temperature always traced (the fused
@@ -725,10 +767,15 @@ class TpuEmbedder:
             temp = self._stage_temp(temperature)
             dev_ids, dev_mask = self._stage_batch(ids, mask)
             if exe is not None:
-                return exe(self.params, dev_ids, dev_mask, temp)
-            return _mesh_embed_and_vote(
-                self.params, dev_ids, dev_mask, temp,
-                n, self.config, self.pooling, self.mesh,
+                return self._timed_dispatch(
+                    label, lambda: exe(self.params, dev_ids, dev_mask, temp)
+                )
+            return self._timed_dispatch(
+                label,
+                lambda: _mesh_embed_and_vote(
+                    self.params, dev_ids, dev_mask, temp,
+                    n, self.config, self.pooling, self.mesh,
+                ),
             )
         # the Pallas fast path bakes its temperature in; any other
         # value rides the traced-jnp vote (no per-value recompiles)
@@ -737,22 +784,28 @@ class TpuEmbedder:
             ("vote1", ids.shape[0], ids.shape[1], use_fused), ids, mask
         )
         if exe is not None:
-            return exe(
-                self.params,
-                jnp.asarray(ids),
-                jnp.asarray(mask),
-                jnp.asarray(float(temperature), jnp.float32),
+            return self._timed_dispatch(
+                label,
+                lambda: exe(
+                    self.params,
+                    jnp.asarray(ids),
+                    jnp.asarray(mask),
+                    jnp.asarray(float(temperature), jnp.float32),
+                ),
             )
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
-        return _embed_and_vote(
-            self.params,
-            dev_ids,
-            dev_mask,
-            float(temperature),
-            n,
-            self.config,
-            self.pooling,
-            use_fused=use_fused,
+        return self._timed_dispatch(
+            label,
+            lambda: _embed_and_vote(
+                self.params,
+                dev_ids,
+                dev_mask,
+                float(temperature),
+                n,
+                self.config,
+                self.pooling,
+                use_fused=use_fused,
+            ),
         )
 
     def consensus_confidence_tokens_many(
@@ -783,24 +836,31 @@ class TpuEmbedder:
             ids = ids.reshape(r * n, s)
             mask = mask.reshape(r * n, s)
         flat_ids, flat_mask = self._pad_rows(ids, mask)
+        label = f"many(r={r_bucket},n={n},s={s})"
         exe = self._aot_lookup(
             self._aot_key(("many", r_bucket, n, s)), flat_ids, flat_mask
         )
         if exe is not None:
             dev_ids, dev_mask = self._stage_batch(flat_ids, flat_mask)
-            conf = exe(
-                self.params,
-                dev_ids,
-                dev_mask,
-                self._stage_temp(temperature),
+            conf = self._timed_dispatch(
+                label,
+                lambda: exe(
+                    self.params,
+                    dev_ids,
+                    dev_mask,
+                    self._stage_temp(temperature),
+                ),
             )
             return conf[:r]
         dev_ids, dev_mask = self.put_batch(
             jnp.asarray(flat_ids), jnp.asarray(flat_mask)
         )
-        conf = _embed_and_vote_many(
-            self.params, dev_ids, dev_mask, float(temperature), r_bucket,
-            n, self.config, self.pooling,
+        conf = self._timed_dispatch(
+            label,
+            lambda: _embed_and_vote_many(
+                self.params, dev_ids, dev_mask, float(temperature), r_bucket,
+                n, self.config, self.pooling,
+            ),
         )
         return conf[:r]
 

@@ -11,6 +11,15 @@ With no sink configured nothing ever activates a root span, and every
 ambient helper here is a single contextvar read returning None.
 """
 
+from .histogram import Histogram  # noqa: F401
+from .phases import (  # noqa: F401
+    PHASES,
+    observe_device,
+    observe_phase,
+    phase_breakdown,
+    phases_snapshot,
+    reset_phases,
+)
 from .propagate import (  # noqa: F401
     TRACEPARENT_HEADER,
     extract,

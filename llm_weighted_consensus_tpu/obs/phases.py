@@ -1,0 +1,245 @@
+"""Phase-attributed request timing (ISSUE 11 tentpole piece 1).
+
+BENCH_r03's post-mortem had to hand-derive that device time was 35 ms
+of a 145 ms p50; this module makes that split a first-class, always-on
+aggregate.  Every scored request is bracketed through a fixed phase
+vocabulary:
+
+* ``admission_wait``  — gateway door to admission slot held
+* ``batcher_queue``   — item enqueued to its group taking the device
+* ``pack_plan``       — host-side ragged packing plan (packed path)
+* ``device_dispatch`` — the device executable itself, measured with
+  ``block_until_ready`` at the embedder seam, per (mesh-shape, bucket)
+* ``host_tally``      — consensus tally / packed reassembly on host
+* ``upstream_judge``  — judge LLM streaming fan-out
+
+Two consumers, two mechanisms:
+
+1. **Aggregates** — instrumentation sites call ``observe_phase`` /
+   ``observe_device`` directly into one process-global aggregator of
+   mergeable log-bucket histograms (obs/histogram.py).  Global on
+   purpose: the sites span the event loop, executor threads and the
+   score client, and the phases section must work for harnesses that
+   drive the batcher without a gateway (bench_scaling.py).  The
+   aggregator takes a lock per observe — the executor threads are real
+   writers — and the whole observe stays inside the ≤2% hot-path
+   budget (bench_host.py --metrics-overhead).
+2. **Per-request breakdown** — ``phase_breakdown(trace)`` re-derives
+   the same vocabulary from a finished PR 5 span tree (interval union,
+   so R concurrent judge streams attribute wall time once, not R
+   times).  The gateway's trace middleware annotates every root span
+   with it; tests assert the phase sum lands within 10% of the
+   request's end-to-end latency.
+
+Stdlib-only, dependency-free below ``utils`` like the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .histogram import Histogram
+
+# the phase vocabulary, in request order; the /metrics ``phases``
+# section and the BENCH phase summaries render exactly these keys
+PHASES = (
+    "admission_wait",
+    "batcher_queue",
+    "pack_plan",
+    "device_dispatch",
+    "host_tally",
+    "upstream_judge",
+)
+
+
+class PhaseAggregator:
+    """Process-global phase + per-bucket device-time histograms.
+
+    Lock-guarded because device timing lands from executor threads
+    while HTTP phases land from the event loop; each observe is one
+    O(1) histogram increment under an uncontended lock."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._phases: Dict[str, Histogram] = {}
+        self._device: Dict[str, Histogram] = {}
+
+    def observe_phase(self, phase: str, ms: float) -> None:
+        with self._lock:
+            hist = self._phases.get(phase)
+            if hist is None:
+                hist = self._phases[phase] = Histogram()
+            hist.observe(ms)
+
+    def observe_device(self, bucket: str, ms: float) -> None:
+        """One device executable run at ``bucket`` (a canonical label
+        like ``vote1(n=8,s=16)@dp4xtp2``): feeds both the per-bucket
+        table the roofline gauge reads and the ``device_dispatch``
+        phase aggregate."""
+        with self._lock:
+            hist = self._device.get(bucket)
+            if hist is None:
+                hist = self._device[bucket] = Histogram()
+            hist.observe(ms)
+            phist = self._phases.get("device_dispatch")
+            if phist is None:
+                phist = self._phases["device_dispatch"] = Histogram()
+            phist.observe(ms)
+
+    # -- read side ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /metrics ``phases`` section: per-phase histogram summary
+        plus the device share of all attributed time (the figure
+        BENCH_r03 had to hand-derive)."""
+        with self._lock:
+            rows = {
+                phase: hist.to_json_obj()
+                for phase, hist in self._phases.items()
+            }
+            total = sum(h.sum for h in self._phases.values())
+            device = self._phases.get("device_dispatch")
+            device_sum = device.sum if device is not None else 0.0
+        out: dict = {phase: rows[phase] for phase in PHASES if phase in rows}
+        out["device_time_share"] = (
+            round(device_sum / total, 4) if total > 0 else None
+        )
+        return out
+
+    def device_snapshot(self) -> Dict[str, dict]:
+        """Per-(mesh-shape, bucket) device-time summaries."""
+        with self._lock:
+            return {
+                bucket: hist.to_json_obj()
+                for bucket, hist in sorted(self._device.items())
+            }
+
+    def raw_histograms(self) -> Tuple[Dict[str, Histogram], Dict[str, Histogram]]:
+        """Cloned (phases, device) histogram maps for the Prometheus
+        renderer — clones, so rendering never races an executor-thread
+        observe."""
+        with self._lock:
+            return (
+                {k: _clone(h) for k, h in self._phases.items()},
+                {k: _clone(h) for k, h in self._device.items()},
+            )
+
+    def device_quantile(self, bucket: str, q: float) -> Optional[float]:
+        with self._lock:
+            hist = self._device.get(bucket)
+            return hist.quantile(q) if hist is not None else None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._phases.clear()
+            self._device.clear()
+
+
+def _clone(hist: Histogram) -> Histogram:
+    return Histogram().merge(hist)
+
+
+_AGG = PhaseAggregator()
+
+
+def aggregator() -> PhaseAggregator:
+    return _AGG
+
+
+def observe_phase(phase: str, ms: float) -> None:
+    _AGG.observe_phase(phase, ms)
+
+
+def observe_device(bucket: str, ms: float) -> None:
+    _AGG.observe_device(bucket, ms)
+
+
+def phases_snapshot() -> dict:
+    return _AGG.snapshot()
+
+
+def reset_phases() -> None:
+    _AGG.reset()
+
+
+# ---------------------------------------------------------------------------
+# Per-request breakdown from a finished span tree
+# ---------------------------------------------------------------------------
+
+
+def _union_ms(intervals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of (start, end) intervals — concurrent
+    judge streams (or pipelined dispatches) attribute wall time once."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    total = 0.0
+    cur_start, cur_end = intervals[0]
+    for start, end in intervals[1:]:
+        if start > cur_end:
+            total += cur_end - cur_start
+            cur_start, cur_end = start, end
+        else:
+            cur_end = max(cur_end, end)
+    return total + (cur_end - cur_start)
+
+
+def phase_breakdown(trace) -> dict:
+    """Attribute one finished trace's wall time to the phase vocabulary.
+
+    Span-derived: ``batcher:*`` minus its ``device:dispatch`` children
+    is queue time; the dispatch bracket minus the batcher span's
+    annotated host sub-costs (``pack_plan_ms`` / ``host_tally_ms``,
+    stamped per item by the packed dispatch) is device time;
+    ``consensus:tally`` and ``judge:stream`` map directly;
+    ``admission_wait_ms`` rides a root annotation (the admission
+    middleware runs before any child span exists).  Returns
+    ``{phase: ms}`` plus ``e2e_ms`` and the unattributed ``other_ms``
+    remainder — the acceptance bar is that the named phases sum to
+    within 10% of ``e2e_ms`` on a served request."""
+    batcher: List[Tuple[float, float]] = []
+    device: List[Tuple[float, float]] = []
+    tally: List[Tuple[float, float]] = []
+    judge: List[Tuple[float, float]] = []
+    pack_plan_ms = 0.0
+    tally_attr_ms = 0.0
+    root = trace.spans[0] if trace.spans else None
+    for span in trace.spans:
+        dur = span.duration_ms()
+        if dur is None:
+            continue
+        start = span.start_ms()
+        interval = (start, start + dur)
+        name = span.name
+        if name.startswith("batcher:"):
+            batcher.append(interval)
+            pack_plan_ms += float(span.attributes.get("pack_plan_ms", 0.0))
+            tally_attr_ms += float(span.attributes.get("host_tally_ms", 0.0))
+        elif name == "device:dispatch":
+            device.append(interval)
+        elif name == "consensus:tally":
+            tally.append(interval)
+        elif name == "judge:stream":
+            judge.append(interval)
+    device_ms = _union_ms(device)
+    batcher_ms = max(0.0, _union_ms(batcher) - device_ms)
+    out = {
+        "admission_wait": float(
+            root.attributes.get("admission_wait_ms", 0.0)
+        )
+        if root is not None
+        else 0.0,
+        "batcher_queue": batcher_ms,
+        "pack_plan": pack_plan_ms,
+        "device_dispatch": max(0.0, device_ms - pack_plan_ms - tally_attr_ms),
+        "host_tally": _union_ms(tally) + tally_attr_ms,
+        "upstream_judge": _union_ms(judge),
+    }
+    out = {k: round(v, 3) for k, v in out.items()}
+    e2e = root.duration_ms() if root is not None else None
+    if e2e is not None:
+        attributed = sum(out.values())
+        out["e2e_ms"] = e2e
+        out["other_ms"] = round(max(0.0, e2e - attributed), 3)
+    return out

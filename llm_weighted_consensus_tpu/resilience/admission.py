@@ -32,7 +32,12 @@ from typing import Callable, Optional
 
 # probes must keep answering while the service sheds or drains — a load
 # balancer that cannot read /readyz cannot take us out of rotation
-EXEMPT_PATHS = frozenset({"/healthz", "/livez", "/readyz", "/metrics"})
+# /v1/profile rides the probe exemption too: an on-demand profiler
+# capture is exactly the tool for diagnosing an overload, so the gate
+# must not shed it (serve/gateway.py guards it behind PROFILE_DIR)
+EXEMPT_PATHS = frozenset(
+    {"/healthz", "/livez", "/readyz", "/metrics", "/v1/profile"}
+)
 
 # endpoints whose handler requires a live device forward: when the
 # watchdog marks the device unhealthy and no CPU fallback is configured,
@@ -225,6 +230,7 @@ def admission_middleware(admission: AdmissionController):
     from aiohttp import web
 
     from ..obs import annotate as trace_annotate
+    from ..obs import observe_phase
 
     @web.middleware
     async def _mw(request, handler):
@@ -234,6 +240,7 @@ def admission_middleware(admission: AdmissionController):
             "/v1/traces"
         ):
             return await handler(request)
+        t_wait = time.perf_counter()
         reason = admission.try_acquire(
             device_work=request.path in DEVICE_PATHS
         )
@@ -242,7 +249,15 @@ def admission_middleware(admission: AdmissionController):
             # this one); the 503 status forces trace retention there
             trace_annotate(shed_reason=reason)
             return shed_response(reason, admission.config.retry_after_ms)
-        trace_annotate(admission_inflight=admission.inflight)
+        # door -> slot held; the gate is currently admit-or-shed (no
+        # queueing), so this phase reads ~0 until a waiting acquire
+        # exists — recorded anyway so the breakdown stays complete
+        wait_ms = (time.perf_counter() - t_wait) * 1e3
+        observe_phase("admission_wait", wait_ms)
+        trace_annotate(
+            admission_inflight=admission.inflight,
+            admission_wait_ms=round(wait_ms, 3),
+        )
         t0 = admission.clock()
         error = True
         try:

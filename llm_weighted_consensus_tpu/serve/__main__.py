@@ -770,6 +770,11 @@ def build_service(
     # --fake-upstream is demo/test mode: synthetic embedder params are
     # allowed (still logged); production startup refuses them
     embedder = build_embedder(config, allow_synthetic=fake_upstream)
+    if embedder is not None:
+        # per-bucket device timing (phases/roofline sections); the knob
+        # exists because the block_until_ready bracket serializes the
+        # dispatch pipeline when METRICS_DEVICE_TIMING=0 matters more
+        embedder.device_timing = config.metrics_device_timing
     packed_buckets = []
     if embedder is not None and config.warmup:
         if config.packing_enabled and embedder.supports_packing():

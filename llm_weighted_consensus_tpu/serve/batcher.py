@@ -48,7 +48,7 @@ import numpy as np
 class _Item:
     __slots__ = (
         "kind", "key", "payload", "future", "deadline", "span",
-        "redispatches",
+        "redispatches", "submitted",
     )
 
     def __init__(self, kind, key, payload, future, deadline=None, span=None):
@@ -56,6 +56,10 @@ class _Item:
         self.key = key
         self.payload = payload
         self.future = future
+        # enqueue timestamp: _run_group attributes (dispatch start -
+        # submitted) to the ``batcher_queue`` phase per item, including
+        # any fault re-queue wait (obs/phases.py)
+        self.submitted = time.perf_counter()
         # the request's propagated deadline (resilience/deadline.py),
         # captured at submit so the pre-dispatch shed can drop work
         # that can no longer finish in time
@@ -678,6 +682,12 @@ class DeviceBatcher:
         t0 = time.perf_counter()
         token = object()
         self._inflight[token] = t0
+        from ..obs import phases as _phases
+
+        for item in group:
+            _phases.observe_phase(
+                "batcher_queue", (t0 - item.submitted) * 1e3
+            )
         # device wall-time children on each traced item's batcher span,
         # bracketing exactly what the watchdog brackets (the executor
         # hop + the PJRT call); the mesh epoch stamps which shape served
@@ -1063,25 +1073,36 @@ class DeviceBatcher:
             # one (first-class mesh embedders pack fine and never land
             # here)
             return [self._packed_item_fallback(item, embedder) for item in group]
+        from ..obs import phases as _phases
+
         row_tokens = self.packing_row_tokens
         seg_cap = min(row_tokens, embedder.max_tokens)
         segments: list = []  # ragged int32 token rows, group-global
         plans: list = []  # one assembly plan per item
+        # pack_plan phase: ragged tokenization + first-fit packing (the
+        # host work BEFORE any device call); runs on the executor
+        # thread, so it reports to the lock-guarded global aggregator
+        # and stamps each item's batcher span (annotate is a plain dict
+        # update — no span creation off the event loop)
+        t_plan = time.perf_counter()
         for item in group:
             plans.append(
                 self._plan_packed_item(
                     item, embedder, segments, seg_cap, row_tokens
                 )
             )
+        plan_ms = (time.perf_counter() - t_plan) * 1e3
         results: list = [None] * len(group)
         seg_vecs: list = [None] * len(segments)
         if segments:
+            t_plan = time.perf_counter()
             calls = _packing.build_calls(
                 segments,
                 row_tokens,
                 self.packing_max_rows,
                 self.packing_max_segments,
             )
+            plan_ms += (time.perf_counter() - t_plan) * 1e3
             for call in calls:
                 out = embedder.embed_packed(
                     call.ids, call.segment_ids, call.positions,
@@ -1095,10 +1116,24 @@ class DeviceBatcher:
                 )
                 for si, (r, slot) in call.slots.items():
                     seg_vecs[si] = np.asarray(out[r, slot], np.float32)
+        # host_tally phase: per-item reassembly + the host-side vote
+        # (packing.consensus_vote_np)
+        t_tally = time.perf_counter()
         for i, (item, plan) in enumerate(zip(group, plans)):
             results[i] = self._assemble_packed_item(
                 item, plan, segments, seg_vecs, embedder
             )
+        tally_ms = (time.perf_counter() - t_tally) * 1e3
+        _phases.observe_phase("pack_plan", plan_ms)
+        _phases.observe_phase("host_tally", tally_ms)
+        share_plan = plan_ms / len(group)
+        share_tally = tally_ms / len(group)
+        for item in group:
+            if item.span is not None:
+                item.span.annotate(
+                    pack_plan_ms=round(share_plan, 3),
+                    host_tally_ms=round(share_tally, 3),
+                )
         return results
 
     def _plan_packed_item(

@@ -51,9 +51,12 @@ TPU additions:
   specializations compiled on previous runs load from disk, cutting
   cold-start latency (first-request compiles take tens of seconds for
   large encoders).  Unset = in-memory cache only.
-* ``PROFILE_DIR`` — arms ``POST /profile/start`` / ``POST /profile/stop``:
-  JAX profiler traces (xprof format, viewable in TensorBoard/xprof) are
-  written under this directory.  Unset = endpoints disabled (404).
+* ``PROFILE_DIR`` — arms ``POST /profile/start`` / ``POST /profile/stop``
+  and the one-shot ``POST /v1/profile`` (bounded ``duration_ms`` capture
+  window, admission-exempt so an overload can be profiled while the gate
+  sheds): JAX profiler traces (xprof format, viewable in
+  TensorBoard/xprof) are written under this directory.  Unset =
+  start/stop disabled (404) and ``/v1/profile`` answers 403.
 * ``RM_MODEL`` / ``RM_WEIGHTS`` / ``RM_VOCAB`` / ``RM_MAX_TOKENS`` /
   ``RM_QUANTIZE`` (``int8`` = W8A8 RM serving, default ``none``) — a
   DeBERTa reward model serving ``POST /consensus {"scorer": "rm"}``
@@ -298,6 +301,18 @@ span is ever created and the hot path pays one contextvar read):
 * ``TRACE_DIR`` — optional JSONL disk tier: one JSON line per kept
   trace appended to ``traces-<pid>.jsonl`` under this directory
   (setting it also enables tracing).
+
+Performance observability (obs/phases.py, obs/histogram.py,
+analysis/roofline.py — DESIGN.md "Performance observability"):
+
+* ``METRICS_DEVICE_TIMING`` — per-bucket device-time measurement at the
+  embedder seam: every dispatch is bracketed with ``block_until_ready``
+  and lands in the ``phases`` / ``roofline`` sections of ``GET /metrics``
+  keyed by its (mesh-shape, bucket) label.  Default on; ``0`` disables
+  the bracket (dispatches return dispatch-async again, device rows and
+  roofline attainment go dark, the other phases keep reporting).
+  ``GET /metrics?format=prometheus`` renders the same data as
+  OpenMetrics text with trace-id exemplars on the hot series.
 
 Incoming ``traceparent`` headers (W3C) are honored — the caller's
 trace id is adopted and its sampled flag forces capture — and every
@@ -589,6 +604,10 @@ class Config:
     trace_enabled: bool = False
     trace_ring: int = 256
     trace_dir: Optional[str] = None
+    # per-bucket device timing (block_until_ready bracket at the
+    # embedder seam) feeding the phases/roofline metrics sections;
+    # METRICS_DEVICE_TIMING=0 returns dispatches to dispatch-async
+    metrics_device_timing: bool = True
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -750,6 +769,9 @@ class Config:
             trace_enabled=env_truthy(env.get("TRACE_ENABLED", "0")),
             trace_ring=max(1, int(env.get("TRACE_RING", 256))),
             trace_dir=env.get("TRACE_DIR"),
+            metrics_device_timing=env_truthy(
+                env.get("METRICS_DEVICE_TIMING", "1")
+            ),
         )
         if not 0 <= config.resilience_quorum <= 1:
             raise ValueError(

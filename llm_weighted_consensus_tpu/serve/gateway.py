@@ -22,10 +22,13 @@ from ..errors import (
     with_trace_id,
 )
 from .metrics import (
+    PROM_CONTENT_TYPE,
     Metrics,
     middleware,
     register_overload,
+    register_performance,
     register_resilience,
+    render_prometheus,
 )
 from ..types.chat_request import ChatCompletionCreateParams as ChatParams
 from ..types.embeddings import CreateEmbeddingParams
@@ -236,6 +239,16 @@ def trace_middleware(sink):
                     root.trace.force(f"http_{status}")
             obs.Span.deactivate(token)
             root.finish()
+            try:
+                # the per-request phase attribution (obs/phases.py):
+                # derived from the finished span tree and stamped on the
+                # root, so every retained trace explains where its
+                # milliseconds went without a second tool
+                root.annotate(
+                    phase_breakdown=obs.phase_breakdown(root.trace)
+                )
+            except Exception:
+                pass  # attribution must never break serving
             sink.offer(root.trace)
 
     return _mw
@@ -432,7 +445,82 @@ def _profile_handlers(profile_dir: str):
                 return _error_response(e)
         return web.json_response({"ok": True, "dir": profile_dir})
 
-    return start, stop
+    async def capture(request: web.Request):
+        """POST /v1/profile: one-shot capture — start, sleep the
+        requested window while live traffic runs, stop.  Bounded so a
+        fat-fingered duration can't leave the profiler running; the
+        admission middleware exempts this path (profiling an overload
+        is the point), so the guard here is PROFILE_DIR alone."""
+        import asyncio
+
+        import jax
+
+        try:
+            body = jsonutil.loads(await request.text() or "{}")
+        except Exception:
+            body = {}
+        duration_ms = float(body.get("duration_ms", 500.0) or 500.0)
+        duration_ms = min(10_000.0, max(10.0, duration_ms))
+        async with state["lock"]:
+            if state["active"]:
+                return web.json_response(
+                    {"code": 400, "message": "trace already active"},
+                    status=400,
+                )
+            state["active"] = True
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(
+                None, jax.profiler.start_trace, profile_dir
+            )
+            # capture window: the loop keeps serving, so in-flight and
+            # new requests land inside the trace
+            await asyncio.sleep(duration_ms / 1e3)
+            await loop.run_in_executor(None, jax.profiler.stop_trace)
+        except Exception as e:
+            return _error_response(e)
+        finally:
+            async with state["lock"]:
+                state["active"] = False
+        return web.json_response(
+            {"ok": True, "dir": profile_dir, "duration_ms": duration_ms}
+        )
+
+    return start, stop, capture
+
+
+async def _profile_disabled(request: web.Request) -> web.Response:
+    """POST /v1/profile without PROFILE_DIR: a clear 403, not a 404 —
+    the endpoint exists, the operator just hasn't enabled it."""
+    return web.json_response(
+        {"code": 403, "message": "profiling disabled: set PROFILE_DIR"},
+        status=403,
+    )
+
+
+def _roofline_gauge(embedder):
+    """Wire the live roofline-attainment gauge (ISSUE 11 tentpole piece
+    3) when a device path exists: committed per-bucket ceilings from
+    analysis/roofline.json against the live device-time histograms.
+    Import-guarded — the gauge is observability, never a serving
+    dependency."""
+    if embedder is None:
+        return None
+    try:
+        import jax
+
+        from ..analysis.roofline import (
+            RooflineGauge,
+            default_roofline_path,
+            load_roofline,
+        )
+
+        roofline = load_roofline(default_roofline_path())
+        if not roofline:
+            return None
+        return RooflineGauge(roofline, jax.default_backend())
+    except Exception:
+        return None
 
 
 def build_app(
@@ -464,6 +552,7 @@ def build_app(
     metrics = metrics or Metrics()
     register_resilience(metrics, resilience, fault_plan)
     register_overload(metrics, admission, watchdog, lifecycle)
+    register_performance(metrics, _roofline_gauge(embedder))
     if embedder is not None and batcher is None:
         from .batcher import DeviceBatcher
 
@@ -574,6 +663,14 @@ def build_app(
         return web.json_response({"ok": True})
 
     async def metrics_handler(request):
+        # ?format=prometheus flips the same data into OpenMetrics text
+        # (histogram families + exemplars); the default JSON snapshot
+        # keeps its PR 5 shape for existing scrapers and the bench tools
+        if request.query.get("format") == "prometheus":
+            return web.Response(
+                body=render_prometheus(metrics).encode("utf-8"),
+                headers={"Content-Type": PROM_CONTENT_TYPE},
+            )
         return web.json_response(metrics.snapshot())
 
     from .lifecycle import health_handlers
@@ -588,9 +685,14 @@ def build_app(
         app.router.add_get("/v1/traces", traces_index)
         app.router.add_get("/v1/traces/{trace_id}", traces_get)
     if profile_dir:
-        start, stop = _profile_handlers(profile_dir)
+        start, stop, capture = _profile_handlers(profile_dir)
         app.router.add_post("/profile/start", start)
         app.router.add_post("/profile/stop", stop)
+        app.router.add_post("/v1/profile", capture)
+    else:
+        # registered either way so the guard is an explicit 403, not a
+        # confusable 404
+        app.router.add_post("/v1/profile", _profile_disabled)
     return app
 
 

@@ -4,16 +4,28 @@ service metrics (qps, p50, device util) out-of-band").
 The reference keeps all observability in-band (per-choice
 ``completion_metadata`` + usage/cost accounting); that is preserved
 bit-exact in the wire types.  This module adds the service-level view the
-reference lacks: per-endpoint request counts and latency percentiles plus
+reference lacks: per-endpoint request counts and latency histograms plus
 device dispatch timings, exposed at ``GET /metrics``.
+
+Two expositions off one store (ISSUE 11):
+
+* the original JSON snapshot — shape-compatible with the pre-histogram
+  dashboards (``count``/``errors``/``p50_ms``/``p99_ms``/``trace_id``
+  per series, provider sections keyed by ``KNOWN_SECTIONS``);
+* ``GET /metrics?format=prometheus`` — OpenMetrics text with full
+  ``_bucket``/``_sum``/``_count`` histogram families and trace-id
+  exemplars on hot series, fed by the same mergeable log-bucket
+  histograms (obs/histogram.py) that replaced the old 1024-sample
+  reservoir, so percentiles no longer silently describe only the last
+  1024 requests.
 """
 
 from __future__ import annotations
 
 import time
-from collections import deque
+from typing import Dict, List, Optional, Tuple
 
-_RESERVOIR = 1024  # recent samples kept per series
+from ..obs.histogram import Histogram, le_for
 
 # Every provider-section name that may appear in the /metrics snapshot.
 # The registry the LWC010 lint checks both ways: a `register_provider`
@@ -32,17 +44,46 @@ KNOWN_SECTIONS = (
     "jit",
     "mesh",
     "meshfault",
+    "phases",
+    "roofline",
 )
+
+# Every Prometheus family the text exposition may emit.  Same contract
+# as KNOWN_SECTIONS, enforced by LWC012 both ways: a `prom_family(...)`
+# call with an unlisted name fails lint, and a listed family no call
+# site emits is stale.  Counter families are declared WITHOUT the
+# `_total` sample suffix (OpenMetrics convention).
+KNOWN_PROM_FAMILIES = (
+    "lwc_uptime_seconds",
+    "lwc_series_requests",
+    "lwc_series_errors",
+    "lwc_series_latency_ms",
+    "lwc_phase_latency_ms",
+    "lwc_device_latency_ms",
+    "lwc_roofline_sol_ms",
+    "lwc_roofline_attainment",
+)
+
+
+class _Series:
+    __slots__ = ("count", "errors", "hist", "exemplar")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.errors = 0
+        self.hist = Histogram()
+        # (trace_id, latency_ms, unix_ts) — enough to render an
+        # OpenMetrics exemplar on the right bucket line
+        self.exemplar: Optional[Tuple[str, float, float]] = None
 
 
 class Metrics:
     def __init__(self) -> None:
-        self._counts: dict = {}
-        self._errors: dict = {}
-        self._latencies: dict = {}
+        self._series_store: Dict[str, _Series] = {}
         self._providers: dict = {}
-        self._exemplars: dict = {}
-        self._started = time.time()
+        # monotonic: wall-clock steps (NTP, leap smear) must not skew
+        # reported uptime
+        self._started = time.monotonic()
 
     def observe(
         self,
@@ -52,18 +93,21 @@ class Metrics:
         error: bool = False,
         trace_id=None,
     ) -> None:
-        self._counts[series] = self._counts.get(series, 0) + 1
+        s = self._series_store.get(series)
+        if s is None:
+            s = self._series_store[series] = _Series()
+        s.count += 1
         if error:
-            self._errors[series] = self._errors.get(series, 0) + 1
-        self._latencies.setdefault(series, deque(maxlen=_RESERVOIR)).append(ms)
+            s.errors += 1
+        s.hist.observe(ms)
         if trace_id is not None:
-            # trace-id exemplar (Prometheus-exemplar analog): the most
-            # recent traced request on this series — an aggregate that
-            # looks wrong links straight to one concrete span tree.
-            # Passed EXPLICITLY by call sites that know the right trace
-            # (ambient reads here would pick up stale contexts from
-            # long-lived tasks like the batcher's flusher).
-            self._exemplars[series] = trace_id
+            # trace-id exemplar: the most recent traced request on this
+            # series — an aggregate that looks wrong links straight to
+            # one concrete span tree.  Passed EXPLICITLY by call sites
+            # that know the right trace (ambient reads here would pick
+            # up stale contexts from long-lived tasks like the
+            # batcher's flusher).
+            s.exemplar = (trace_id, ms, time.time())
 
     def register_provider(self, name: str, fn) -> None:
         """Attach a live gauge section to the snapshot (e.g. the device
@@ -72,20 +116,16 @@ class Metrics:
 
     def snapshot(self) -> dict:
         out = {}
-        for series, count in sorted(self._counts.items()):
-            lat = sorted(self._latencies.get(series, ()))
-            entry = {"count": count, "errors": self._errors.get(series, 0)}
-            if lat:
-                entry["p50_ms"] = round(lat[len(lat) // 2], 2)
-                entry["p99_ms"] = round(
-                    lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2
-                )
-            exemplar = self._exemplars.get(series)
-            if exemplar is not None:
-                entry["trace_id"] = exemplar
+        for series, s in sorted(self._series_store.items()):
+            entry = {"count": s.count, "errors": s.errors}
+            if s.hist.count:
+                entry["p50_ms"] = round(s.hist.quantile(0.5), 2)
+                entry["p99_ms"] = round(s.hist.quantile(0.99), 2)
+            if s.exemplar is not None:
+                entry["trace_id"] = s.exemplar[0]
             out[series] = entry
         snap = {
-            "uptime_sec": round(time.time() - self._started, 1),
+            "uptime_sec": round(time.monotonic() - self._started, 1),
             "series": out,
         }
         for name, fn in self._providers.items():
@@ -94,6 +134,149 @@ class Metrics:
             except Exception as e:  # a broken gauge must not break /metrics
                 snap[name] = {"error": str(e)}
         return snap
+
+    # -- prometheus exposition ----------------------------------------------
+
+    def provider_section(self, name: str):
+        """One provider section by registry name (None when absent or
+        broken) — the Prometheus renderer pulls ``roofline`` this way."""
+        fn = self._providers.get(name)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:
+            return None
+
+    def uptime_sec(self) -> float:
+        return time.monotonic() - self._started
+
+    def series_items(self) -> List[Tuple[str, "_Series"]]:
+        return sorted(self._series_store.items())
+
+
+PROM_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def prom_family(name: str, typ: str, help_text: str) -> List[str]:
+    """The ``# HELP``/``# TYPE`` header for one family.  Call sites MUST
+    pass the family name as a string literal drawn from
+    KNOWN_PROM_FAMILIES — the LWC012 lint checks the two both ways so
+    the text exposition can't drift from what dashboards scrape."""
+    return [f"# HELP {name} {help_text}", f"# TYPE {name} {typ}"]
+
+
+def _esc(label_value: str) -> str:
+    return (
+        label_value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _render_hist(
+    name: str,
+    label: str,
+    value: str,
+    hist: Histogram,
+    exemplar: Optional[Tuple[str, float, float]] = None,
+) -> List[str]:
+    """One labelled histogram as ``_bucket``/``_sum``/``_count`` lines,
+    with the exemplar (when given) attached to the bucket whose range
+    contains the exemplar's own latency (OpenMetrics requires the
+    exemplar value to lie inside its bucket)."""
+    sel = f'{label}="{_esc(value)}"'
+    lines = []
+    ex_le = le_for(exemplar[1]) if exemplar is not None else None
+    for le, cum in hist.cumulative():
+        line = f'{name}_bucket{{{sel},le="{le}"}} {cum}'
+        if ex_le is not None and le == ex_le:
+            trace_id, ms, ts = exemplar
+            line += f' # {{trace_id="{_esc(trace_id)}"}} {ms:.6g} {ts:.3f}'
+            ex_le = None  # first matching line only
+        lines.append(line)
+    lines.append(f"{name}_sum{{{sel}}} {hist.sum:.6g}")
+    lines.append(f"{name}_count{{{sel}}} {hist.count}")
+    return lines
+
+
+def render_prometheus(metrics: Metrics) -> str:
+    """The whole process as OpenMetrics text: uptime, per-series request
+    counters + latency histograms (with trace-id exemplars), the phase
+    and per-bucket device-time histograms from the global phase
+    aggregator, and the roofline attainment gauges when the roofline
+    section is registered.  Ends with the mandatory ``# EOF``."""
+    from ..obs import phases as _phases
+
+    lines: List[str] = []
+    lines += prom_family("lwc_uptime_seconds", "gauge", "Process uptime (monotonic).")
+    lines.append(f"lwc_uptime_seconds {metrics.uptime_sec():.3f}")
+
+    items = metrics.series_items()
+    lines += prom_family(
+        "lwc_series_requests", "counter", "Requests observed per series."
+    )
+    for series, s in items:
+        lines.append(f'lwc_series_requests_total{{series="{_esc(series)}"}} {s.count}')
+    lines += prom_family(
+        "lwc_series_errors", "counter", "Errored requests per series."
+    )
+    for series, s in items:
+        lines.append(f'lwc_series_errors_total{{series="{_esc(series)}"}} {s.errors}')
+    lines += prom_family(
+        "lwc_series_latency_ms",
+        "histogram",
+        "Per-series latency, fixed log buckets (obs/histogram.py).",
+    )
+    for series, s in items:
+        lines += _render_hist(
+            "lwc_series_latency_ms", "series", series, s.hist, s.exemplar
+        )
+
+    phase_hists, device_hists = _phases.aggregator().raw_histograms()
+    lines += prom_family(
+        "lwc_phase_latency_ms",
+        "histogram",
+        "Request time attributed per phase (admission_wait .. upstream_judge).",
+    )
+    for phase in _phases.PHASES:
+        hist = phase_hists.get(phase)
+        if hist is not None:
+            lines += _render_hist("lwc_phase_latency_ms", "phase", phase, hist)
+    lines += prom_family(
+        "lwc_device_latency_ms",
+        "histogram",
+        "block_until_ready device time per (mesh-shape, bucket).",
+    )
+    for bucket, hist in sorted(device_hists.items()):
+        lines += _render_hist("lwc_device_latency_ms", "bucket", bucket, hist)
+
+    roofline = metrics.provider_section("roofline")
+    if isinstance(roofline, dict):
+        rows = roofline.get("buckets", {})
+        lines += prom_family(
+            "lwc_roofline_sol_ms",
+            "gauge",
+            "Speed-of-light time per AOT bucket from analysis/roofline.json.",
+        )
+        for bucket, row in sorted(rows.items()):
+            sol = row.get("sol_ms")
+            if sol is not None:
+                lines.append(
+                    f'lwc_roofline_sol_ms{{bucket="{_esc(bucket)}"}} {sol:.6g}'
+                )
+        lines += prom_family(
+            "lwc_roofline_attainment",
+            "gauge",
+            "sol_ms / measured device p50 per AOT bucket (1.0 = roofline).",
+        )
+        for bucket, row in sorted(rows.items()):
+            att = row.get("attainment")
+            if att is not None:
+                lines.append(
+                    f'lwc_roofline_attainment{{bucket="{_esc(bucket)}"}} {att:.6g}'
+                )
+
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
 
 
 def register_resilience(metrics: Metrics, policy, fault_plan=None) -> None:
@@ -129,6 +312,18 @@ def register_overload(
         metrics.register_provider("device_watchdog", watchdog.snapshot)
     if lifecycle is not None:
         metrics.register_provider("lifecycle", lifecycle.snapshot)
+
+
+def register_performance(metrics: Metrics, roofline=None) -> None:
+    """Surface the ISSUE 11 performance-observability sections: the
+    ``phases`` aggregate (per-phase histograms + device-time share) and,
+    when a gauge is supplied, the ``roofline`` per-bucket attainment
+    table."""
+    from ..obs import phases as _phases
+
+    metrics.register_provider("phases", _phases.phases_snapshot)
+    if roofline is not None:
+        metrics.register_provider("roofline", roofline.snapshot)
 
 
 def _series(request) -> str:

@@ -1,0 +1,16 @@
+"""LWC012 violating fixture: the prometheus family registry out of sync
+with the exposition in both directions — an undeclared family, a dead
+registry row, and a computed (non-literal) family name."""
+
+KNOWN_PROM_FAMILIES = ("app_uptime_seconds", "app_flatlined_panel")
+
+
+def prom_family(name, typ, help_text):
+    return [f"# HELP {name} {help_text}", f"# TYPE {name} {typ}"]
+
+
+def render(dynamic):
+    lines = prom_family("app_uptime_seconds", "gauge", "Uptime.")
+    lines += prom_family("app_rogue_series", "counter", "Unscrapeable.")
+    lines += prom_family(f"app_{dynamic}_ms", "histogram", "Invisible.")
+    return lines

@@ -1,0 +1,14 @@
+"""LWC012 conforming fixture: every declared prometheus family has a
+literal prom_family call site and every call site uses a declared name."""
+
+KNOWN_PROM_FAMILIES = ("app_uptime_seconds", "app_latency_ms")
+
+
+def prom_family(name, typ, help_text):
+    return [f"# HELP {name} {help_text}", f"# TYPE {name} {typ}"]
+
+
+def render():
+    lines = prom_family("app_uptime_seconds", "gauge", "Uptime.")
+    lines += prom_family("app_latency_ms", "histogram", "Latency.")
+    return lines

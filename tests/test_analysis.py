@@ -47,6 +47,7 @@ EXPECTED_BAD = {
     "LWC009": 2,  # jnp call + jax call inside one coroutine
     "LWC010": 3,  # undeclared section + dead registry row + rogue span
     "LWC011": 2,  # undocumented from_env knob + stale README token
+    "LWC012": 3,  # undeclared family + dead registry row + non-literal name
 }
 
 

@@ -117,6 +117,17 @@ def phase_summary() -> dict:
     }
 
 
+def consensus_quality_summary() -> dict:
+    """The consensus-quality block every BENCH record embeds (ISSUE 12):
+    request count, degraded rate, median confidence margin, the
+    max−min judge-agreement spread, and any drift-flagged judges, from
+    the process-global quality aggregator.  Harnesses reset it together
+    with the phase aggregator so the block covers the timed window."""
+    from llm_weighted_consensus_tpu.obs import quality_summary
+
+    return quality_summary()
+
+
 def bench_tokenizer():
     """A WordPiece tokenizer (native C++ ASCII fast path when built)
     covering the bench word list — the deployment-shaped host path, and

@@ -319,6 +319,130 @@ def metrics_overhead_record(args) -> dict:
     return record
 
 
+def quality_overhead_record(args) -> dict:
+    """--quality-overhead: the cost of the consensus-quality observe
+    hot path (ISSUE 12 satellite), against the same discipline as
+    --metrics-overhead: always-on observability stays under a 2% p50
+    inflation budget.
+
+    Same deterministic form, both measurements device-free:
+
+    1. ns/op of the lock-guarded ``QualityAggregator.observe_outcome``
+       on a synthetic panel-shaped outcome (args.judges ballots over
+       args.n candidates — the worst realistic shape: every judge
+       voted, so calibration bins, the drift window, and all pairwise
+       kappa cells update).
+    2. The real host consensus path driven with the tally-seam
+       observation live (clients/score.py emits exactly one Outcome
+       per scored request), for the p50 denominator.
+
+    The reported overhead is the share of the host-path p50 spent
+    inside observe_outcome."""
+    from decimal import Decimal
+
+    from bench import BASELINE_BASIS, make_requests
+    from llm_weighted_consensus_tpu.obs import quality as quality_mod
+    from llm_weighted_consensus_tpu.types.score_request import (
+        ChatCompletionCreateParams as ScoreParams,
+    )
+
+    # -- 1. ns/op on the panel-shaped outcome, minus the loop's own cost ------
+    n = max(2, args.n)
+    judges = max(2, args.judges)
+    ballots = []
+    for j in range(judges):
+        # distinct per-judge vote mass so argmax, bins, and kappa
+        # marginals all exercise their real branches (float votes,
+        # exactly as the seam hands them over)
+        top = (j * 7) % n
+        rest = 0.4 / (n - 1)
+        vote = [rest] * n
+        vote[top] = 0.6
+        ballots.append(
+            quality_mod.JudgeBallot(
+                model=f"bench-judge-{j}",
+                model_index=j,
+                weight=Decimal(1),
+                vote=vote,
+            )
+        )
+    outcome = quality_mod.Outcome(
+        winner=0,
+        margin=0.25,
+        weight_sum=Decimal(judges),
+        n_choices=n,
+        degraded=False,
+        quorum_degraded=False,
+        all_failed=False,
+        trace_id="bench-trace",
+        judges=ballots,
+    )
+    reps = 50_000
+
+    def loop_ns(fn) -> float:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn(outcome)
+        return (time.perf_counter() - t0) / reps * 1e9
+
+    baseline_ns = loop_ns(lambda o: None)
+    agg = quality_mod.QualityAggregator()
+    observe_outcome_ns = max(0.0, loop_ns(agg.observe_outcome) - baseline_ns)
+
+    # -- 2. host-path p50 with the tally-seam observation live ----------------
+    n_requests = min(args.requests, 20)
+    client, model_json = build_engine(
+        args.judges, args.n, n_requests + 1, args.seed
+    )
+    texts_per_request = make_requests(n_requests, args.n, seed=args.seed)
+
+    async def score_one(texts):
+        params = ScoreParams.from_json_obj(
+            {
+                "messages": [{"role": "user", "content": "pick the best"}],
+                "model": model_json,
+                "choices": texts,
+            }
+        )
+        stream = await client.create_streaming(None, params)
+        return [item async for item in stream]
+
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(score_one(texts_per_request[0]))  # warm
+    quality_mod.reset_quality()
+    total_ms = []
+    for texts in texts_per_request:
+        t0 = time.perf_counter()
+        loop.run_until_complete(score_one(texts))
+        total_ms.append((time.perf_counter() - t0) * 1e3)
+    loop.close()
+    observed = quality_mod.quality_snapshot()["requests"]
+    p50_ms = round(statistics.median(total_ms), 3)
+    overhead_pct = round(observe_outcome_ns / (p50_ms * 1e6) * 100.0, 4)
+    budget_pct = 2.0
+    return {
+        "metric": "quality observe_outcome share of host-path p50",
+        "value": overhead_pct,
+        "unit": "%",
+        "budget_pct": budget_pct,
+        "within_budget": overhead_pct <= budget_pct,
+        "observe_outcome_ns": round(observe_outcome_ns, 1),
+        "outcomes_per_request": round(observed / max(1, n_requests), 2),
+        "host_p50_ms": p50_ms,
+        "requests": n_requests,
+        "judges": args.judges,
+        "n_candidates": args.n,
+        "jax_imported": "jax" in sys.modules,
+        "baseline_basis": BASELINE_BASIS,
+        "note": (
+            "overhead = lock-guarded observe_outcome ns / host p50 "
+            "(exactly one outcome per scored request): the "
+            "deterministic form of the <=2% p50 inflation bar; observe "
+            "site: clients/score.py tally seam"
+        ),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--judges", type=int, default=8)
@@ -339,7 +463,27 @@ def main() -> None:
             "2%% p50 inflation budget instead of the host path"
         ),
     )
+    ap.add_argument(
+        "--quality-overhead",
+        action="store_true",
+        help=(
+            "measure the consensus-quality observe_outcome hot path "
+            "against the 2%% p50 inflation budget instead of the host path"
+        ),
+    )
     args = ap.parse_args()
+
+    if args.quality_overhead:
+        record = quality_overhead_record(args)
+        assert record["jax_imported"] is False, (
+            "host bench must stay device-free"
+        )
+        print(json.dumps(record), flush=True)
+        assert record["within_budget"], (
+            f"observe_outcome costs {record['value']}% of host p50, "
+            f"budget {record['budget_pct']}%"
+        )
+        return
 
     if args.metrics_overhead:
         record = metrics_overhead_record(args)

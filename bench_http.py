@@ -92,6 +92,7 @@ from bench import (  # noqa: E402
     BASELINE_BASIS,
     BENCH_WORDS,
     bench_tokenizer,
+    consensus_quality_summary,
     make_requests,
     phase_summary,
 )
@@ -102,6 +103,7 @@ def emit(endpoint: str, value: float, unit: str, **extra) -> None:
     # (the service runs in-process, so the global aggregator — reset by
     # _drive after warmup — covers exactly the measured traffic)
     extra.setdefault("phase_breakdown", phase_summary())
+    extra.setdefault("quality_summary", consensus_quality_summary())
     print(
         json.dumps(
             {
@@ -217,11 +219,13 @@ async def _drive(session, url, bodies, concurrency, warmup_bursts=2):
     for _ in range(warmup_bursts):
         burst = (bodies * ((concurrency // len(bodies)) + 1))[:concurrency]
         await asyncio.gather(*(one(b, record=False) for b in burst))
-    # scope the phase aggregator to the timed window (the summary every
-    # emitted record embeds via bench.phase_summary)
-    from llm_weighted_consensus_tpu.obs import reset_phases
+    # scope the phase and quality aggregators to the timed window (the
+    # summaries every emitted record embeds via bench.phase_summary /
+    # bench.consensus_quality_summary)
+    from llm_weighted_consensus_tpu.obs import reset_phases, reset_quality
 
     reset_phases()
+    reset_quality()
     t0 = time.perf_counter()
     await asyncio.gather(*(one(b) for b in bodies))
     return time.perf_counter() - t0, lat

@@ -376,6 +376,8 @@ class ScoreClient:
         cache=None,
         flights=None,
         resilience=None,
+        bias_plan=None,
+        ledger=None,
     ) -> None:
         self.chat_client = chat_client
         self.model_fetcher = model_fetcher
@@ -399,6 +401,12 @@ class ScoreClient:
         # shared across the judge fan-out and weight-quorum graceful
         # degradation.  None (the default) = pre-resilience behavior.
         self.resilience = resilience
+        # optional resilience.JudgeBiasPlan: deterministic per-judge vote
+        # perturbation (JUDGE_BIAS_PLAN) for consensus-quality drills
+        self.bias_plan = bias_plan
+        # optional obs.OutcomeLedger: one record per scored request
+        # (LEDGER_RING/LEDGER_DIR), the weight-learning training substrate
+        self.ledger = ledger
 
     # -- unary (client.rs:71-91) --------------------------------------------
 
@@ -634,6 +642,7 @@ class ScoreClient:
         ]
 
         degraded = False
+        quorum_degraded = False
         merged = merge_streams(judge_streams)
         try:
             async for chunk in merged:
@@ -664,6 +673,7 @@ class ScoreClient:
                         # (closing the merge cancels pumps and judge
                         # streams, which close their upstreams) and ship
                         degraded = True
+                        quorum_degraded = True
                         policy.inc("quorum_degraded")
                         obs.annotate(quorum=quorum.explain())
                         break
@@ -740,8 +750,23 @@ class ScoreClient:
         aggregate.usage = usage
         if degraded:
             aggregate.degraded = True
+        all_failed = all_error and len(model.llms) > 0
+        # winner + confidence margin (top1 - top2) are consensus-health
+        # facts, computed Decimal-exact whether or not a trace is live —
+        # the quality aggregates must not depend on sampling
+        winner = None
+        margin = None
+        if weight_sum > 0:
+            winner = max(range(n_choices), key=lambda i: choice_weight[i])
+            ranked = sorted(choice_weight, reverse=True)
+            top2 = ranked[1] if len(ranked) > 1 else Decimal(0)
+            margin = float((ranked[0] - top2) / weight_sum)
         explain_candidates: list = []
         explain_judges: list = []
+        quality_ballots: list = []
+        want_ledger = self.ledger is not None
+        conf_vec = [0.0] * n_choices
+        ledger_judges: list = []
         for choice in aggregate.choices:
             if choice.index < n_choices:
                 w = choice_weight[choice.index]
@@ -749,6 +774,8 @@ class ScoreClient:
                 choice.confidence = (
                     w / weight_sum if weight_sum > 0 else Decimal(0)
                 )
+                if want_ledger:
+                    conf_vec[choice.index] = float(choice.confidence)
                 if tspan is not None:
                     explain_candidates.append(
                         {
@@ -768,6 +795,35 @@ class ScoreClient:
                     )
                     confidence += share * v
                 choice.confidence = confidence
+                judge_weight = (
+                    choice.weight if choice.weight is not None else Decimal(0)
+                )
+                # one Decimal->float pass per ballot, shared by the
+                # quality ballot and the ledger record (the weight
+                # itself stays Decimal for the exact weight share)
+                fvote = [float(v) for v in vote]
+                quality_ballots.append(
+                    obs.JudgeBallot(
+                        choice.model or "",
+                        choice.model_index,
+                        judge_weight,
+                        fvote,
+                    )
+                )
+                if want_ledger:
+                    ledger_judges.append(
+                        {
+                            "model": choice.model,
+                            "model_index": choice.model_index,
+                            "weight": float(judge_weight),
+                            "vote": fvote,
+                            "error": None,
+                            # the judge's vote-mass-weighted share of the
+                            # final confidence vector: the Decimal-exact
+                            # alignment score weights/learning.py trains on
+                            "alignment": float(confidence),
+                        }
+                    )
                 if tspan is not None:
                     explain_judges.append(
                         {
@@ -776,29 +832,55 @@ class ScoreClient:
                             "weight": float(choice.weight)
                             if choice.weight is not None
                             else None,
-                            "vote": [float(v) for v in vote],
+                            "vote": fvote,
                             "confidence_contribution": float(confidence),
                             "error": choice.error.code
                             if choice.error is not None
                             else None,
                         }
                     )
-            elif tspan is not None:
+            else:
                 # voteless judge choice: errored or cancelled
-                explain_judges.append(
-                    {
-                        "model": choice.model,
-                        "model_index": choice.model_index,
-                        "weight": float(choice.weight)
-                        if choice.weight is not None
-                        else None,
-                        "vote": None,
-                        "confidence_contribution": 0.0,
-                        "error": choice.error.code
-                        if choice.error is not None
-                        else None,
-                    }
+                error_code = (
+                    choice.error.code if choice.error is not None else None
                 )
+                quality_ballots.append(
+                    obs.JudgeBallot(
+                        choice.model or "",
+                        choice.model_index,
+                        choice.weight
+                        if choice.weight is not None
+                        else Decimal(0),
+                        None,
+                        error_code,
+                    )
+                )
+                if want_ledger:
+                    ledger_judges.append(
+                        {
+                            "model": choice.model,
+                            "model_index": choice.model_index,
+                            "weight": float(choice.weight)
+                            if choice.weight is not None
+                            else None,
+                            "vote": None,
+                            "error": error_code,
+                            "alignment": None,
+                        }
+                    )
+                if tspan is not None:
+                    explain_judges.append(
+                        {
+                            "model": choice.model,
+                            "model_index": choice.model_index,
+                            "weight": float(choice.weight)
+                            if choice.weight is not None
+                            else None,
+                            "vote": None,
+                            "confidence_contribution": 0.0,
+                            "error": error_code,
+                        }
+                    )
             choice.delta = Delta()
             choice.finish_reason = None
             choice.logprobs = None
@@ -807,11 +889,6 @@ class ScoreClient:
             # degraded: keep per-judge failure detail on the final frame so
             # unary consumers see WHY the panel is partial
         if tspan is not None:
-            winner = None
-            if weight_sum > 0:
-                winner = max(
-                    range(n_choices), key=lambda i: choice_weight[i]
-                )
             tspan.annotate(
                 judges=explain_judges,
                 candidates=explain_candidates,
@@ -820,6 +897,43 @@ class ScoreClient:
                 degraded=degraded,
             )
             tspan.finish()
+        trace_id = obs.current_trace_id()
+        # consensus-quality aggregates: scorecards, pairwise agreement,
+        # drift windows, margin histogram (obs/quality.py) — always on,
+        # like the phase aggregate below
+        obs.observe_outcome(
+            obs.Outcome(
+                winner=winner,
+                margin=margin,
+                weight_sum=weight_sum if weight_sum > 0 else Decimal(0),
+                n_choices=n_choices,
+                degraded=degraded,
+                quorum_degraded=quorum_degraded,
+                all_failed=all_failed,
+                trace_id=trace_id,
+                judges=quality_ballots,
+            )
+        )
+        if self.ledger is not None:
+            # one ledger record per scored request: the persistent
+            # training substrate for weight learning / archive re-scoring
+            self.ledger.offer(
+                {
+                    "id": resp_id,
+                    "created": created,
+                    "panel": model.id,
+                    "n_choices": n_choices,
+                    "winner": winner,
+                    "confidence": conf_vec,
+                    "margin": margin,
+                    "weight_sum": float(weight_sum),
+                    "degraded": degraded,
+                    "quorum_degraded": quorum_degraded,
+                    "all_failed": all_failed,
+                    "trace_id": trace_id,
+                    "judges": ledger_judges,
+                }
+            )
         # host_tally phase: the weighted-vote fold + final-frame build
         # (runs with or without a live trace — the aggregate must not
         # depend on sampling)
@@ -830,12 +944,18 @@ class ScoreClient:
             # degraded consensus is always retained, whatever the sample
             # rate said at the door
             obs.force_keep("degraded")
+        if all_failed:
+            # an all-judges-failed tally is exactly as diagnosis-worthy
+            # as a degraded one; the unary path can surface it as a
+            # merged 4xx, which the trace middleware's >=500 forcing
+            # would otherwise drop
+            obs.force_keep("all_failed")
         # the final frame carries the trace id so SSE consumers can fetch
         # the explain trace from /v1/traces/{trace_id}
-        aggregate.trace_id = obs.current_trace_id()
+        aggregate.trace_id = trace_id
         yield aggregate
 
-        if all_error and len(model.llms) > 0:
+        if all_failed:
             yield AllVotesFailed(all_error_code)
 
     @staticmethod
@@ -1083,6 +1203,11 @@ class ScoreClient:
                     agg_choice.delta.content,
                     logprob_tokens,
                 )
+                if self.bias_plan is not None:
+                    # JUDGE_BIAS_PLAN drill seam: deterministically
+                    # miscalibrate the targeted judge's extracted vote
+                    # (Decimal-in, Decimal-out) before it enters the tally
+                    vote = self.bias_plan.perturb(llm.index, vote)
                 choice.delta.vote = vote
                 obs.annotate(vote=[float(v) for v in vote])
             except InvalidContentError as e:

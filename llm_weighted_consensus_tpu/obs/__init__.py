@@ -12,6 +12,7 @@ ambient helper here is a single contextvar read returning None.
 """
 
 from .histogram import Histogram  # noqa: F401
+from .ledger import LEDGER_SCHEMA, OutcomeLedger  # noqa: F401
 from .phases import (  # noqa: F401
     PHASES,
     observe_device,
@@ -26,6 +27,17 @@ from .propagate import (  # noqa: F401
     format_traceparent,
     inject,
     parse_traceparent,
+)
+from .quality import (  # noqa: F401
+    JudgeBallot,
+    Outcome,
+    QualityAggregator,
+    configure_quality,
+    observe_outcome,
+    quality_aggregator,
+    quality_snapshot,
+    quality_summary,
+    reset_quality,
 )
 from .sink import TraceSink  # noqa: F401
 from .span import (  # noqa: F401

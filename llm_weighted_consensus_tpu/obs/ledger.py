@@ -1,0 +1,97 @@
+"""Persistent consensus-outcome ledger (ISSUE 12 tentpole piece 3).
+
+One self-describing record per scored request — panel id, per-judge
+votes and weights, the confidence vector, the degraded/quorum verdict
+and the trace id — retained in a bounded in-memory ring and optionally
+appended to a per-process JSONL file, following the ``obs/sink.py``
+ring + disk pattern (``LEDGER_RING`` / ``LEDGER_DIR``).
+
+Unlike the trace sink there is no sampling: the ledger is the training
+substrate ROADMAP items 4–5 (archive re-scoring, on-TPU weight
+learning) consume, and a sampled training set would silently bias the
+learned weights.  Every offered record is kept; the ring bounds memory
+and the JSONL tier is append-only and crash-tolerant like
+``cache/store.py``.
+
+The record schema is pinned by the ledger → training round-trip test:
+per-judge rows carry the soft vote vector (floats) and the
+Decimal-exact alignment score already computed by the tally, so
+``weights/training_table.py`` ingests them without transformation.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Optional
+
+from ..utils import jsonutil
+
+# schema tag stamped on every record; bump on incompatible change
+LEDGER_SCHEMA = "lwc.outcome.v1"
+
+
+class OutcomeLedger:
+    """Single-threaded by contract (mutated only from the event loop),
+    like TraceSink and every counter object in the serving stack."""
+
+    def __init__(
+        self,
+        capacity: int = 256,
+        disk_dir: Optional[str] = None,
+    ) -> None:
+        self.capacity = max(1, int(capacity))
+        self._ring: OrderedDict = OrderedDict()
+        self.kept = 0
+        self._disk_path: Optional[str] = None
+        self._disk_errors = 0
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+            self._disk_path = os.path.join(
+                disk_dir, f"ledger-{os.getpid()}.jsonl"
+            )
+
+    def offer(self, record: dict) -> None:
+        """Request end: keep (ring + disk) in O(1); never raises into
+        the request path."""
+        record.setdefault("schema", LEDGER_SCHEMA)
+        key = record.get("id") or f"outcome-{self.kept}"
+        self.kept += 1
+        self._ring[key] = record
+        self._ring.move_to_end(key)
+        while len(self._ring) > self.capacity:
+            self._ring.popitem(last=False)
+        if self._disk_path is not None:
+            try:
+                with open(self._disk_path, "a", encoding="utf-8") as f:
+                    f.write(jsonutil.dumps(record) + "\n")
+            except OSError:
+                # the ledger must never fail the request path; the
+                # error count surfaces on /metrics instead
+                self._disk_errors += 1
+
+    # -- read side ------------------------------------------------------------
+
+    def index(self, limit: int = 50) -> list:
+        """Recent-first records, newest first."""
+        out = []
+        for record in reversed(self._ring.values()):
+            out.append(record)
+            if len(out) >= limit:
+                break
+        return out
+
+    def get(self, record_id: str) -> Optional[dict]:
+        return self._ring.get(record_id)
+
+    # -- observability of the observer (metrics provider "ledger") ------------
+
+    def snapshot(self) -> dict:
+        return {
+            "schema": LEDGER_SCHEMA,
+            "capacity": self.capacity,
+            "size": len(self._ring),
+            "kept": self.kept,
+            "disk_errors": self._disk_errors,
+            "disk_path": self._disk_path,
+        }

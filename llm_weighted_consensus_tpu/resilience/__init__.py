@@ -57,7 +57,11 @@ from .admission import AdmissionConfig, AdmissionController  # noqa: F401
 from .breaker import BreakerConfig, BreakerRegistry, CircuitBreaker  # noqa: F401
 from .budget import RetryBudget, current_retry_budget  # noqa: F401
 from .deadline import Deadline, current_deadline  # noqa: F401
-from .faults import FaultInjectionTransport, FaultPlan  # noqa: F401
+from .faults import (  # noqa: F401
+    FaultInjectionTransport,
+    FaultPlan,
+    JudgeBiasPlan,
+)
 from .hedge import HedgePolicy, LatencyTracker  # noqa: F401
 from .meshfault import (  # noqa: F401
     DeviceFaultPlan,
@@ -123,6 +127,7 @@ __all__ = [
     "InjectedHangError",
     "InjectedPersistentError",
     "InjectedTransientError",
+    "JudgeBiasPlan",
     "LatencyTracker",
     "MeshFaultManager",
     "QuorumTracker",

@@ -141,6 +141,165 @@ class FaultPlan:
         }
 
 
+# judge-bias kinds, in the fixed order the sampler walks (order is part
+# of the determinism contract — do not reorder).  All three perturb a
+# soft vote vector Decimal-in, Decimal-out (LWC005):
+#
+# * ``flip``    — rotate the vote vector one candidate left: the judge
+#   systematically prefers "the next" candidate, so it disagrees with
+#   consensus on any peaked ballot;
+# * ``uniform`` — replace the vote with 1/n everywhere: a maximally
+#   hedged, uninformative judge (calibration drifts, agreement decays
+#   toward chance);
+# * ``invert``  — reverse the vote vector: strong votes become strong
+#   votes for the opposite end of the candidate list.
+BIAS_FLIP = "flip"
+BIAS_UNIFORM = "uniform"
+BIAS_INVERT = "invert"
+
+BIAS_KINDS = (BIAS_FLIP, BIAS_UNIFORM, BIAS_INVERT)
+
+
+class JudgeBiasPlan:
+    """Deterministic per-judge vote perturbation (``JUDGE_BIAS_PLAN``).
+
+    The consensus-quality analogue of ``FaultPlan``: where FaultPlan
+    breaks the transport, this miscalibrates a *judge* — so the drift
+    detector in ``obs/quality.py`` can be drilled with a reproducibly
+    biased panel member instead of waiting for a real model to rot.
+
+    Determinism does not depend on judge-stream interleaving: each
+    judge keeps its own ballot ordinal, and the per-ballot decision is
+    drawn from ``random.Random((seed << 16) ^ (judge << 8) ^ ordinal)``
+    — the same judge sees the same perturbation sequence no matter how
+    the async fan-out schedules it.  ``after`` healthy ballots are
+    passed through first so the drift baseline can form before the
+    bias begins.
+    """
+
+    def __init__(
+        self,
+        judge: int = 0,
+        seed: int = 0,
+        after: int = 0,
+        probabilities: Optional[Dict[str, float]] = None,
+        script: Optional[List[Optional[str]]] = None,
+    ) -> None:
+        self.judge = int(judge)
+        self.seed = int(seed)
+        self.after = max(0, int(after))
+        self.probabilities = {
+            kind: float((probabilities or {}).get(kind, 0.0))
+            for kind in BIAS_KINDS
+        }
+        self._script = list(script) if script is not None else None
+        self._ordinals: Dict[int, int] = {}
+        self.injected: Dict[str, int] = {kind: 0 for kind in BIAS_KINDS}
+
+    @classmethod
+    def parse(cls, spec: str) -> "JudgeBiasPlan":
+        """Parse a ``JUDGE_BIAS_PLAN`` env spec.
+
+        Comma-separated ``key=value``: ``judge`` (target judge index),
+        ``seed``, ``after`` (healthy ballots before bias begins), one
+        key per bias kind with its probability, or ``script=flip|ok``
+        (``ok``/empty = honest ballot), e.g.
+        ``judge=2,after=16,flip=1.0,seed=7``.
+        """
+        judge = 0
+        seed = 0
+        after = 0
+        probs: Dict[str, float] = {}
+        script: Optional[List[Optional[str]]] = None
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    f"JUDGE_BIAS_PLAN: expected key=value, got {part!r}"
+                )
+            key, _, value = part.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if key == "judge":
+                judge = int(value)
+            elif key == "seed":
+                seed = int(value)
+            elif key == "after":
+                after = int(value)
+            elif key == "script":
+                script = [
+                    None if slot in ("", "ok") else slot
+                    for slot in value.split("|")
+                ]
+                for slot in script:
+                    if slot is not None and slot not in BIAS_KINDS:
+                        raise ValueError(
+                            f"JUDGE_BIAS_PLAN: unknown bias {slot!r}"
+                        )
+            elif key in BIAS_KINDS:
+                probs[key] = float(value)
+            else:
+                raise ValueError(f"JUDGE_BIAS_PLAN: unknown key {key!r}")
+        return cls(
+            judge=judge,
+            seed=seed,
+            after=after,
+            probabilities=probs,
+            script=script,
+        )
+
+    def _next_bias(self, judge_index: int) -> Optional[str]:
+        ordinal = self._ordinals.get(judge_index, 0)
+        self._ordinals[judge_index] = ordinal + 1
+        if judge_index != self.judge or ordinal < self.after:
+            return None
+        slot = ordinal - self.after
+        if self._script is not None:
+            if slot >= len(self._script):
+                return None
+            bias = self._script[slot]
+            if bias is not None:
+                self.injected[bias] += 1
+            return bias
+        draw = random.Random(
+            (self.seed << 16) ^ (judge_index << 8) ^ slot
+        ).random()
+        edge = 0.0
+        for kind in BIAS_KINDS:
+            edge += self.probabilities[kind]
+            if draw < edge:
+                self.injected[kind] += 1
+                return kind
+        return None
+
+    def perturb(self, judge_index: int, vote: list) -> list:
+        """The (possibly perturbed) vote for ``judge_index``'s next
+        ballot.  Decimal-in, Decimal-out: every kind permutes or
+        replaces entries without any float arithmetic."""
+        bias = self._next_bias(judge_index)
+        if bias is None or len(vote) < 2:
+            return vote
+        if bias == BIAS_FLIP:
+            return list(vote[1:]) + [vote[0]]
+        if bias == BIAS_INVERT:
+            return list(reversed(vote))
+        # BIAS_UNIFORM
+        from decimal import Decimal
+
+        n = Decimal(len(vote))
+        return [Decimal(1) / n for _ in vote]
+
+    def snapshot(self) -> dict:
+        return {
+            "judge": self.judge,
+            "after": self.after,
+            "ballots": dict(self._ordinals),
+            "injected": {k: v for k, v in self.injected.items() if v},
+        }
+
+
 class _SyntheticBadStatus:
     """A response-shaped 503 that never touched the network."""
 

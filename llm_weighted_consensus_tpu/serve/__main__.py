@@ -1067,6 +1067,17 @@ def build_service(
                 embedder, tables, batcher=batcher
             )
         )
+    # QUALITY_*: drift-window knobs applied to the process-global
+    # consensus-quality aggregator (always on, like the phase aggregate)
+    from ..obs import configure_quality
+
+    configure_quality(
+        window=config.quality_window,
+        drift_threshold=config.quality_drift_threshold,
+    )
+    # LEDGER_*: per-request consensus-outcome records (obs/ledger.py);
+    # None keeps the tally ledger-free
+    ledger = config.outcome_ledger()
     score_client = ScoreClient(
         chat_client,
         model_registry,
@@ -1080,6 +1091,9 @@ def build_service(
         cache=score_cache,
         # RESILIENCE_*: shared retry budget + weight-quorum degradation
         resilience=resilience,
+        # JUDGE_BIAS_PLAN: deterministic vote perturbation (drills only)
+        bias_plan=config.judge_bias_injection_plan(),
+        ledger=ledger,
     )
     multichat_client = MultichatClient(
         chat_client, model_registry, archive_fetcher=store
@@ -1143,6 +1157,7 @@ def build_service(
         meshfault=meshfault,
         # TRACE_*: request tracing (obs/); None preserves untraced behavior
         trace_sink=config.trace_sink(),
+        ledger=ledger,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables

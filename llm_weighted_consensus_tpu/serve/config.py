@@ -314,6 +314,38 @@ analysis/roofline.py — DESIGN.md "Performance observability"):
   ``GET /metrics?format=prometheus`` renders the same data as
   OpenMetrics text with trace-id exemplars on the hot series.
 
+Consensus-quality observability (obs/quality.py, obs/ledger.py —
+DESIGN.md "Consensus quality"; the scorecard/SLI aggregates are always
+on like the phase histograms, these knobs tune or extend them):
+
+* ``QUALITY_WINDOW`` — ballots in each judge's sliding drift window;
+  a judge is compared against its pre-window baseline and flagged only
+  once BOTH hold a full window (cold judges never flag on noise).
+  Default 64.
+* ``QUALITY_DRIFT_THRESHOLD`` — how far a judge's windowed agreement
+  rate or vote-mass-on-winner may fall below its baseline before the
+  drift detector flags it, as an absolute rate drop in (0, 1].
+  Default 0.25.
+* ``LEDGER_RING`` — consensus-outcome records kept in memory (one per
+  scored request: panel id, per-judge votes + weights, confidence
+  vector, degraded/quorum verdict, trace id — the training substrate
+  for weight learning and archive re-scoring).  ``0`` (the default)
+  disables the ledger unless ``LEDGER_DIR`` is set (which implies a
+  ring of 256).
+* ``LEDGER_DIR`` — append-only JSONL disk tier for the ledger:
+  one self-describing line per record in ``ledger-<pid>.jsonl``
+  (setting it also enables the ledger).
+* ``JUDGE_BIAS_PLAN`` — deterministic per-judge vote perturbation at
+  the extraction seam (the ``FAULT_PLAN`` contract applied to a judge's
+  ballot), e.g. ``judge=2,after=16,flip=1.0,seed=7`` with kinds
+  ``flip`` / ``uniform`` / ``invert`` (resilience/faults.py
+  JudgeBiasPlan).  Consensus-quality drills and tier-1 tests only;
+  never set in production.
+
+Scorecards ride ``GET /v1/judges`` (+ ``/v1/judges/{id}``) and the
+``quality`` section of ``GET /metrics``; the ledger's counters ride
+the ``ledger`` section.
+
 Incoming ``traceparent`` headers (W3C) are honored — the caller's
 trace id is adopted and its sampled flag forces capture — and every
 upstream judge call carries a ``traceparent`` naming the attempt span
@@ -608,6 +640,17 @@ class Config:
     # embedder seam) feeding the phases/roofline metrics sections;
     # METRICS_DEVICE_TIMING=0 returns dispatches to dispatch-async
     metrics_device_timing: bool = True
+    # consensus-quality observability (obs/quality.py): drift-window
+    # size and the agreement/calibration drop that flags a judge
+    quality_window: int = 64
+    quality_drift_threshold: float = 0.25
+    # consensus-outcome ledger (obs/ledger.py): ring capacity (0 = off
+    # unless ledger_dir is set) and the optional JSONL disk tier
+    ledger_ring: int = 0
+    ledger_dir: Optional[str] = None
+    # deterministic judge-vote perturbation spec (JudgeBiasPlan.parse);
+    # None = off (consensus-quality drills and tier-1 tests only)
+    judge_bias_plan: Optional[str] = None
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -772,7 +815,22 @@ class Config:
             metrics_device_timing=env_truthy(
                 env.get("METRICS_DEVICE_TIMING", "1")
             ),
+            quality_window=int(env.get("QUALITY_WINDOW", 64)),
+            quality_drift_threshold=get_f("QUALITY_DRIFT_THRESHOLD", 0.25),
+            ledger_ring=_non_negative_int(env, "LEDGER_RING", 0),
+            ledger_dir=env.get("LEDGER_DIR"),
+            judge_bias_plan=env.get("JUDGE_BIAS_PLAN"),
         )
+        if config.quality_window < 1:
+            raise ValueError(
+                f"QUALITY_WINDOW={config.quality_window} must be >= 1 "
+                "(ballots per judge in the sliding drift window)"
+            )
+        if not 0 < config.quality_drift_threshold <= 1:
+            raise ValueError(
+                f"QUALITY_DRIFT_THRESHOLD={config.quality_drift_threshold} "
+                "must be an absolute rate drop in (0, 1]"
+            )
         if not 0 <= config.resilience_quorum <= 1:
             raise ValueError(
                 f"RESILIENCE_QUORUM={config.resilience_quorum} must be a "
@@ -971,6 +1029,27 @@ class Config:
         from ..resilience import DeviceFaultPlan
 
         return DeviceFaultPlan.parse(self.device_fault_plan)
+
+    def judge_bias_injection_plan(self):
+        """Parsed JUDGE_BIAS_PLAN, or None (quality drills only)."""
+        if not self.judge_bias_plan:
+            return None
+        from ..resilience import JudgeBiasPlan
+
+        return JudgeBiasPlan.parse(self.judge_bias_plan)
+
+    def outcome_ledger(self):
+        """The configured OutcomeLedger, or None when nothing enables it
+        (None keeps the tally seam ledger-free — resilience_policy()
+        discipline).  LEDGER_DIR alone implies the default ring of 256."""
+        if self.ledger_ring <= 0 and not self.ledger_dir:
+            return None
+        from ..obs import OutcomeLedger
+
+        return OutcomeLedger(
+            capacity=self.ledger_ring if self.ledger_ring > 0 else 256,
+            disk_dir=self.ledger_dir,
+        )
 
     def trace_sink(self):
         """The configured TraceSink, or None when nothing enables

@@ -27,6 +27,7 @@ from .metrics import (
     middleware,
     register_overload,
     register_performance,
+    register_quality,
     register_resilience,
     render_prometheus,
 )
@@ -202,7 +203,7 @@ def trace_middleware(sink):
     @web.middleware
     async def _mw(request, handler):
         if request.path in TRACE_EXEMPT_PATHS or request.path.startswith(
-            "/v1/traces"
+            ("/v1/traces", "/v1/judges")
         ):
             return await handler(request)
         upstream = obs.extract(request.headers)
@@ -273,6 +274,37 @@ def _trace_handlers(sink):
                 {"code": 404, "message": "unknown trace_id"}, status=404
             )
         return web.json_response(record)
+
+    return index, get_one
+
+
+def _judge_handlers():
+    """GET /v1/judges (all scorecards) + GET /v1/judges/{judge_id}.
+
+    Reads the process-global quality aggregator (obs/quality.py), so
+    the scorecards exist whether or not tracing or the ledger is
+    configured — same always-on contract as the ``phases`` section."""
+    from ..obs import quality as _quality
+
+    async def index(request: web.Request):
+        agg = _quality.quality_aggregator()
+        return web.json_response(
+            {
+                "window": agg.window,
+                "drift_threshold": agg.drift_threshold,
+                "judges": agg.scorecards(),
+            }
+        )
+
+    async def get_one(request: web.Request):
+        card = _quality.quality_aggregator().scorecard(
+            request.match_info["judge_id"]
+        )
+        if card is None:
+            return web.json_response(
+                {"code": 404, "message": "unknown judge id"}, status=404
+            )
+        return web.json_response(card)
 
     return index, get_one
 
@@ -548,11 +580,13 @@ def build_app(
     watchdog=None,
     meshfault=None,
     trace_sink=None,
+    ledger=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
     register_resilience(metrics, resilience, fault_plan)
     register_overload(metrics, admission, watchdog, lifecycle)
     register_performance(metrics, _roofline_gauge(embedder))
+    register_quality(metrics, ledger)
     if embedder is not None and batcher is None:
         from .batcher import DeviceBatcher
 
@@ -684,6 +718,9 @@ def build_app(
         traces_index, traces_get = _trace_handlers(trace_sink)
         app.router.add_get("/v1/traces", traces_index)
         app.router.add_get("/v1/traces/{trace_id}", traces_get)
+    judges_index, judges_get = _judge_handlers()
+    app.router.add_get("/v1/judges", judges_index)
+    app.router.add_get("/v1/judges/{judge_id}", judges_get)
     if profile_dir:
         start, stop, capture = _profile_handlers(profile_dir)
         app.router.add_post("/profile/start", start)

@@ -46,6 +46,8 @@ KNOWN_SECTIONS = (
     "meshfault",
     "phases",
     "roofline",
+    "quality",
+    "ledger",
 )
 
 # Every Prometheus family the text exposition may emit.  Same contract
@@ -62,6 +64,10 @@ KNOWN_PROM_FAMILIES = (
     "lwc_device_latency_ms",
     "lwc_roofline_sol_ms",
     "lwc_roofline_attainment",
+    "lwc_confidence_margin",
+    "lwc_consensus_outcomes",
+    "lwc_judge_agreement",
+    "lwc_judge_drift",
 )
 
 
@@ -275,6 +281,49 @@ def render_prometheus(metrics: Metrics) -> str:
                     f'lwc_roofline_attainment{{bucket="{_esc(bucket)}"}} {att:.6g}'
                 )
 
+    from ..obs import quality as _quality
+
+    qsnap = _quality.quality_aggregator().prom_snapshot()
+    lines += prom_family(
+        "lwc_confidence_margin",
+        "histogram",
+        "Consensus confidence margin (top1 - top2) per scored request.",
+    )
+    lines += _render_hist(
+        "lwc_confidence_margin",
+        "kind",
+        "margin",
+        qsnap["margin"],
+        qsnap["exemplar"],
+    )
+    lines += prom_family(
+        "lwc_consensus_outcomes",
+        "counter",
+        "Scored requests by consensus outcome (scored/degraded/...).",
+    )
+    for outcome, count in qsnap["outcomes"].items():
+        lines.append(
+            f'lwc_consensus_outcomes_total{{outcome="{_esc(outcome)}"}} {count}'
+        )
+    lines += prom_family(
+        "lwc_judge_agreement",
+        "gauge",
+        "Per-judge agreement-with-final-consensus rate.",
+    )
+    for judge, rate in qsnap["agreement"].items():
+        lines.append(
+            f'lwc_judge_agreement{{judge="{_esc(judge)}"}} {rate:.6g}'
+        )
+    lines += prom_family(
+        "lwc_judge_drift",
+        "gauge",
+        "1 when the drift detector currently flags the judge, else 0.",
+    )
+    for judge, flagged in qsnap["drift_flagged"].items():
+        lines.append(
+            f'lwc_judge_drift{{judge="{_esc(judge)}"}} {flagged:.0f}'
+        )
+
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -324,6 +373,18 @@ def register_performance(metrics: Metrics, roofline=None) -> None:
     metrics.register_provider("phases", _phases.phases_snapshot)
     if roofline is not None:
         metrics.register_provider("roofline", roofline.snapshot)
+
+
+def register_quality(metrics: Metrics, ledger=None) -> None:
+    """Surface the ISSUE 12 consensus-quality sections: the ``quality``
+    aggregate (per-judge scorecards, pairwise kappa, drift flags,
+    margin histogram, outcome rates) and, when an outcome ledger is
+    configured, its ``ledger`` retention counters."""
+    from ..obs import quality as _quality
+
+    metrics.register_provider("quality", _quality.quality_snapshot)
+    if ledger is not None:
+        metrics.register_provider("ledger", ledger.snapshot)
 
 
 def _series(request) -> str:

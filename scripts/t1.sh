@@ -15,6 +15,10 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_serving
 # recovery, the seeded acceptance drill) must fail tier-1 by name even
 # if collection of the glob above breaks.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_meshfault.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_mf=$?; [ $rc -eq 0 ] && rc=$rc_mf; \
+# consensus-quality tests, explicitly: scorecards/kappa/drift, the outcome
+# ledger, the JUDGE_BIAS_PLAN drill, and the ledger→training round trip
+# must fail tier-1 by name even if collection of the glob above breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_quality.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_q=$?; [ $rc -eq 0 ] && rc=$rc_q; \
 # analysis gate, explicitly: tests/test_analysis.py runs the same checker
 # under pytest, but naming the CLI here means a lint finding, a jaxpr
 # serving-path regression, or a mesh-audit failure (sharding coverage /

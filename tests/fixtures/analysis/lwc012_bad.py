@@ -1,8 +1,16 @@
 """LWC012 violating fixture: the prometheus family registry out of sync
-with the exposition in both directions — an undeclared family, a dead
-registry row, and a computed (non-literal) family name."""
+with the exposition in both directions — an undeclared family, dead
+registry rows, a computed (non-literal) family name, and a counter
+declared correctly but EMITTED with the ``_total`` sample suffix in its
+``prom_family`` header (the suffix belongs on sample lines only, so the
+header name never matches the declared row: one undeclared-family
+finding plus one dead-row finding)."""
 
-KNOWN_PROM_FAMILIES = ("app_uptime_seconds", "app_flatlined_panel")
+KNOWN_PROM_FAMILIES = (
+    "app_uptime_seconds",
+    "app_flatlined_panel",
+    "app_outcomes",
+)
 
 
 def prom_family(name, typ, help_text):
@@ -13,4 +21,5 @@ def render(dynamic):
     lines = prom_family("app_uptime_seconds", "gauge", "Uptime.")
     lines += prom_family("app_rogue_series", "counter", "Unscrapeable.")
     lines += prom_family(f"app_{dynamic}_ms", "histogram", "Invisible.")
+    lines += prom_family("app_outcomes_total", "counter", "Outcomes.")
     return lines

@@ -1,7 +1,7 @@
 """LWC012 conforming fixture: every declared prometheus family has a
 literal prom_family call site and every call site uses a declared name."""
 
-KNOWN_PROM_FAMILIES = ("app_uptime_seconds", "app_latency_ms")
+KNOWN_PROM_FAMILIES = ("app_uptime_seconds", "app_latency_ms", "app_outcomes")
 
 
 def prom_family(name, typ, help_text):
@@ -11,4 +11,8 @@ def prom_family(name, typ, help_text):
 def render():
     lines = prom_family("app_uptime_seconds", "gauge", "Uptime.")
     lines += prom_family("app_latency_ms", "histogram", "Latency.")
+    # counter family declared WITHOUT the _total sample suffix; the
+    # sample lines append it (OpenMetrics convention)
+    lines += prom_family("app_outcomes", "counter", "Outcomes.")
+    lines.append('app_outcomes_total{outcome="scored"} 1')
     return lines

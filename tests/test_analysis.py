@@ -47,7 +47,8 @@ EXPECTED_BAD = {
     "LWC009": 2,  # jnp call + jax call inside one coroutine
     "LWC010": 3,  # undeclared section + dead registry row + rogue span
     "LWC011": 2,  # undocumented from_env knob + stale README token
-    "LWC012": 3,  # undeclared family + dead registry row + non-literal name
+    "LWC012": 5,  # undeclared family + dead registry row + non-literal
+    # name + the _total-suffixed counter header (undeclared + dead row)
 }
 
 

@@ -41,6 +41,7 @@ from . import (  # noqa: E402
     lwc010_registry_consistency,
     lwc011_config_readme_drift,
     lwc012_prom_family_registry,
+    lwc013_blocking_readiness,
 )
 
 ALL_RULES: Tuple[Rule, ...] = (
@@ -56,6 +57,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     lwc010_registry_consistency.RULE,
     lwc011_config_readme_drift.RULE,
     lwc012_prom_family_registry.RULE,
+    lwc013_blocking_readiness.RULE,
 )
 
 RULES_BY_NAME = {rule.name: rule for rule in ALL_RULES}

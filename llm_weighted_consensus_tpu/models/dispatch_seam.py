@@ -1,0 +1,198 @@
+"""Deferred-readiness dispatch seam (ISSUE 13 tentpole piece 1).
+
+The old device-timing bracket (`jax.block_until_ready` inside
+``TpuEmbedder._timed_dispatch``) held the dispatch thread for the full
+device time of every timed call, so the executor thread that should
+have been staging group k+1 sat parked on group k's readiness.  This
+module is the replacement contract:
+
+* the **dispatch thread** enters ``deferred_readiness(sink)``, calls
+  the embedder, and returns as soon as every PJRT call is ENQUEUED —
+  each timed dispatch appends a :class:`PendingDispatch` record
+  (label, enqueue timestamp, output handle, waiter callable) to the
+  sink instead of blocking;
+* the batcher's **waiter thread** later runs :func:`drain_sink`, which
+  blocks on each output, records the per-(mesh-shape, bucket) device
+  time and the (enqueue, ready) interval — the ``overlap`` gauge in
+  the ``phases`` metrics section is the union of those intervals over
+  wall time — and recycles any host staging buffers the dispatch
+  checked out of the :class:`StagingPool`.
+
+Device faults therefore surface at the waiter (readiness is where XLA
+reports them), and the batcher's meshfault triage handles waiter-hop
+exceptions exactly like dispatch-hop ones.
+
+Deliberately jax-free at import time: ``bench_host.py
+--overlap-overhead`` measures the seam's pure-Python bookkeeping cost
+against the host p50 budget without pulling jax into the process, and
+test fakes implement a "device" by passing their own ``wait``
+callable.  :func:`wait_device_ready` is the ONE sanctioned blocking
+readiness call on the dispatch path (lint LWC013 allowlists it by
+symbol); everything else must defer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def wait_device_ready(out) -> None:
+    """Default readiness waiter: block until ``out``'s device buffers
+    are materialized.  Runs on the batcher's waiter thread — never on
+    the dispatch thread (LWC013 enforces that split statically)."""
+    import jax
+
+    jax.block_until_ready(out)
+
+
+class PendingDispatch:
+    """One enqueued-but-not-ready device dispatch."""
+
+    __slots__ = ("label", "t0", "out", "wait", "timed")
+
+    def __init__(
+        self,
+        label: str,
+        t0: float,
+        out,
+        wait: Callable = wait_device_ready,
+        timed: bool = True,
+    ) -> None:
+        self.label = label
+        self.t0 = t0
+        self.out = out
+        self.wait = wait
+        # False when METRICS_DEVICE_TIMING=0: the waiter still blocks
+        # (finalize would anyway) but records nothing
+        self.timed = timed
+
+
+class DispatchSink:
+    """Per-group collector the dispatch thread fills under
+    ``deferred_readiness``: the pending device dispatches plus the host
+    staging buffers checked out for them (returned to the pool only
+    after readiness — a ``device_put`` may still be reading the host
+    buffer asynchronously before that)."""
+
+    __slots__ = ("pending", "staged")
+
+    def __init__(self) -> None:
+        self.pending: List[PendingDispatch] = []
+        self.staged: list = []
+
+    def add(self, record: PendingDispatch) -> PendingDispatch:
+        self.pending.append(record)
+        return record
+
+
+_TLS = threading.local()
+
+
+def active_sink() -> Optional[DispatchSink]:
+    """The calling thread's deferred-readiness sink, or None when
+    dispatches should block inline (direct/bench callers)."""
+    return getattr(_TLS, "sink", None)
+
+
+class deferred_readiness:
+    """Context manager scoping a :class:`DispatchSink` to the calling
+    thread.  ``deferred_readiness(None)`` suspends an outer scope (the
+    packed fallback path uses this to run a padded dispatch inline)."""
+
+    __slots__ = ("sink", "_prev")
+
+    def __init__(self, sink: Optional[DispatchSink]) -> None:
+        self.sink = sink
+        self._prev = None
+
+    def __enter__(self) -> Optional[DispatchSink]:
+        self._prev = getattr(_TLS, "sink", None)
+        _TLS.sink = self.sink
+        return self.sink
+
+    def __exit__(self, *exc) -> bool:
+        _TLS.sink = self._prev
+        return False
+
+
+def drain_sink(
+    sink: DispatchSink,
+    observe_device: Optional[Callable[[str, float], None]] = None,
+    observe_interval: Optional[Callable[[float, float], None]] = None,
+    release: Optional[Callable] = None,
+    clock: Callable[[], float] = time.perf_counter,
+) -> None:
+    """The waiter hop: block on every pending dispatch in enqueue
+    order, recording each timed one's device ms (same label contract as
+    the old bracket) and its (enqueue, ready) interval for the overlap
+    gauge.  Staging buffers recycle only on a clean drain — a raising
+    ``wait`` (device fault) propagates to the caller's triage and the
+    buffers are dropped for the GC instead."""
+    for record in sink.pending:
+        record.wait(record.out)
+        t1 = clock()
+        if record.timed:
+            if observe_device is not None:
+                observe_device(record.label, (t1 - record.t0) * 1e3)
+            if observe_interval is not None:
+                observe_interval(record.t0, t1)
+    if release is not None:
+        for buf in sink.staged:
+            release(buf)
+        sink.staged = []
+
+
+class StagingPool:
+    """Per-(shape, dtype) reusable host staging buffers for the padded
+    dispatch paths (ISSUE 13 tentpole piece 3): every padded dispatch
+    used to allocate fresh ``np.pad`` copies of ids/mask per call; the
+    pool hands back the previous group's buffer once its transfer is
+    confirmed ready.  Device-side aliasing stays where it is legal —
+    the ``_stream_vote_update`` donation of same-shape f32 state —
+    because int32 ids/mask alias no f32 output (a measured no-op, see
+    models/embedder.py); host-side reuse is the generalization that IS
+    safe, provided recycling waits for readiness (the waiter's
+    ``release``)."""
+
+    def __init__(self, per_bucket: int = 2) -> None:
+        self.per_bucket = max(0, int(per_bucket))
+        self._lock = threading.Lock()
+        self._free: Dict[Tuple[tuple, str], list] = {}
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.per_bucket > 0
+
+    def acquire(self, shape, dtype) -> np.ndarray:
+        """A writable buffer of exactly ``shape``/``dtype`` — recycled
+        (contents stale: the caller overwrites every row) or fresh."""
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                self.hits += 1
+                return free.pop()
+            self.misses += 1
+        return np.empty(shape, dtype)
+
+    def release(self, buf: np.ndarray) -> None:
+        key = (buf.shape, buf.dtype.str)
+        with self._lock:
+            free = self._free.setdefault(key, [])
+            if len(free) < self.per_bucket:
+                free.append(buf)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "per_bucket": self.per_bucket,
+                "hits": self.hits,
+                "misses": self.misses,
+                "buckets": len(self._free),
+            }

@@ -22,6 +22,12 @@ from ..types.chat_response import Usage
 from ..types.embeddings import CreateEmbeddingResponse, Embedding
 from . import bert
 from .configs import PRESETS, BertConfig
+from .dispatch_seam import (
+    PendingDispatch,
+    StagingPool,
+    active_sink,
+    wait_device_ready,
+)
 from .tokenizer import BaseTokenizer, load_tokenizer
 
 
@@ -273,10 +279,21 @@ class TpuEmbedder:
         self.batch_sharding = None
         self.repl_sharding = None
         # per-(mesh-shape, bucket) device timing at the dispatch seam
-        # (obs/phases.py; METRICS_DEVICE_TIMING=0 clears it): each timed
-        # dispatch blocks until ready, which the serving paths do anyway
-        # (they consume results on host immediately after)
+        # (obs/phases.py; METRICS_DEVICE_TIMING=0 clears it).  Direct
+        # callers pay a block-until-ready bracket; under the batcher's
+        # deferred-readiness sink (dispatch_seam.py) the dispatch thread
+        # returns at enqueue and the waiter records the same numbers
         self.device_timing = True
+        # host staging-buffer pool for the padded dispatch paths
+        # (STAGING_BUFFERS; serve/__main__ re-sizes it): buffers recycle
+        # via the batcher's waiter once the consuming dispatch is ready
+        self.staging_pool = StagingPool(per_bucket=2)
+        # device-resident vote-temperature scalars per value (satellite:
+        # every consensus dispatch used to re-transfer the same float);
+        # entries pin the sharding they were placed with, so a mesh
+        # re-shard (new repl_sharding object) can never serve stale
+        # placements
+        self._temp_cache: dict = {}
 
     # -- AOT bucket precompile ------------------------------------------------
 
@@ -320,30 +337,102 @@ class TpuEmbedder:
         """Run one device dispatch under its canonical bucket label —
         the SAME label the mesh audit measures and ``roofline.json``
         keys, suffixed ``@dp{dp}xtp{tp}`` in mesh mode so the fault
-        ladder's rungs report separately — and record the
-        block-until-ready wall time into the global phase aggregator
-        (the ``device_dispatch`` phase + the roofline gauge's per-bucket
-        p50)."""
-        if not self.device_timing:
+        ladder's rungs report separately — and account its device wall
+        time into the global phase aggregator (the ``device_dispatch``
+        phase + the roofline gauge's per-bucket p50).
+
+        Two readiness modes (dispatch_seam.py): under the batcher's
+        deferred-readiness sink the PJRT call is merely ENQUEUED here —
+        a PendingDispatch record hands (label, t0, output) to the
+        waiter, which blocks, records the identical numbers, and frees
+        this thread to stage the next group.  Without a sink (direct
+        and bench callers) the old block-until-ready bracket runs
+        inline, now also feeding the overlap gauge's interval union."""
+        sink = active_sink()
+        if sink is None and not self.device_timing:
             return fn()
         t0 = time.perf_counter()
         out = fn()
-        jax.block_until_ready(out)
         if self.mesh_mode:
             dp, tp = self.mesh_shape
             label = f"{label}@dp{dp}xtp{tp}"
+        if sink is not None:
+            sink.add(
+                PendingDispatch(label, t0, out, timed=self.device_timing)
+            )
+            return out
+        wait_device_ready(out)
+        t1 = time.perf_counter()
         from ..obs import phases as _phases
 
-        _phases.observe_device(label, (time.perf_counter() - t0) * 1e3)
+        _phases.observe_device(label, (t1 - t0) * 1e3)
+        _phases.observe_device_interval(t0, t1)
         return out
+
+    def _finish(self, out):
+        """Materialize a dispatch output for host consumers — unless a
+        deferred-readiness sink is active, in which case the device
+        array is returned as-is (slices stay lazy) and the batcher's
+        waiter converts after readiness."""
+        if active_sink() is not None:
+            return out
+        return np.asarray(out)
 
     def _stage_temp(self, temperature):
         """The vote temperature as a device scalar (replicated over the
-        mesh in mesh mode — the executable baked that sharding)."""
-        t = jnp.asarray(float(temperature), jnp.float32)
+        mesh in mesh mode — the executable baked that sharding), cached
+        per value: the serving paths send the same default temperature
+        on every consensus dispatch, and the fresh host->device scalar
+        transfer it used to pay is pure per-dispatch overhead.  Each
+        entry pins the sharding object it was placed with; a re-shard
+        replaces ``repl_sharding``, so stale placements miss."""
+        key = float(temperature)
+        expected = self.repl_sharding if self.mesh_mode else None
+        hit = self._temp_cache.get(key)
+        if hit is not None and hit[0] is expected:
+            return hit[1]
+        t = jnp.asarray(key, jnp.float32)
         if self.mesh_mode:
             t = jax.device_put(t, self.repl_sharding)
+        if len(self._temp_cache) >= 64:
+            self._temp_cache.clear()
+        self._temp_cache[key] = (expected, t)
         return t
+
+    def _stage_pad(self, ids, mask, pad_b: int, pad_attend: bool = False):
+        """Pad the batch dim to ``pad_b`` rows.  Under a deferred-
+        readiness sink the rows land in reusable per-bucket staging
+        buffers (StagingPool) instead of fresh ``np.pad`` copies — the
+        buffers recycle via the waiter once the consuming dispatch is
+        ready, because an async ``device_put`` may still be reading
+        them before that.  ``pad_attend`` makes pad rows attend to one
+        [PAD] token (the consensus contract; callers slice them off
+        pre-vote)."""
+        b = ids.shape[0]
+        sink = active_sink()
+        pool = self.staging_pool
+        if (
+            sink is not None
+            and pool is not None
+            and pool.enabled
+            and ids.dtype == np.int32
+            and mask.dtype == np.int32
+        ):
+            pids = pool.acquire((pad_b, ids.shape[1]), np.int32)
+            pmask = pool.acquire((pad_b, mask.shape[1]), np.int32)
+            pids[:b] = ids
+            pids[b:] = 0
+            pmask[:b] = mask
+            pmask[b:] = 0
+            if pad_attend:
+                pmask[b:, 0] = 1
+            sink.staged.extend((pids, pmask))
+            return pids, pmask
+        ids = np.pad(np.asarray(ids), ((0, pad_b - b), (0, 0)))
+        mask = np.pad(np.asarray(mask), ((0, pad_b - b), (0, 0)))
+        if pad_attend:
+            mask[b:, 0] = 1
+        return ids, mask
 
     def _aot_lookup(self, key, ids, mask):
         if not self._aot or not self._aot_ready():
@@ -612,8 +701,7 @@ class TpuEmbedder:
         pad_b = _bucket(b, self.MAX_DEVICE_BATCH)
         pad_b += (-pad_b) % self.batch_multiple  # keep the dp split divisible
         if pad_b != b:
-            ids = np.pad(ids, ((0, pad_b - b), (0, 0)))
-            mask = np.pad(mask, ((0, pad_b - b), (0, 0)))
+            ids, mask = self._stage_pad(ids, mask, pad_b)
         if self.embed_override is not None:
             return np.asarray(self.embed_override(ids, mask)[:b])
         label = f"embed(b={pad_b},s={ids.shape[1]})"
@@ -625,7 +713,7 @@ class TpuEmbedder:
             emb = self._timed_dispatch(
                 label, lambda: exe(self.params, dev_ids, dev_mask)
             )
-            return np.asarray(emb[:b])
+            return self._finish(emb[:b])
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))
         emb = self._timed_dispatch(
             label,
@@ -638,7 +726,7 @@ class TpuEmbedder:
                 normalize=True,
             ),
         )
-        return np.asarray(emb[:b])
+        return self._finish(emb[:b])
 
     # -- packed (continuous-batching) path ------------------------------------
 
@@ -709,7 +797,7 @@ class TpuEmbedder:
                     self.params, dev_ids, dev_segs, dev_pos, dev_starts
                 ),
             )
-            return np.asarray(out)[:b]
+            return self._finish(out)[:b]
         dev_ids, dev_segs, dev_pos, dev_starts = self._stage_batch(
             ids, segment_ids, positions, seg_starts
         )
@@ -726,7 +814,7 @@ class TpuEmbedder:
                 normalize=True,
             ),
         )
-        return np.asarray(out)[:b]
+        return self._finish(out)[:b]
 
     def consensus_confidence(
         self,
@@ -742,12 +830,13 @@ class TpuEmbedder:
     def _pad_rows(self, ids: np.ndarray, mask: np.ndarray):
         """Pad the batch dim to a multiple of ``batch_multiple`` so the dp
         sharding divides evenly.  Pad rows attend to one [PAD] token (a
-        clean forward, no 0/0 pooling); callers slice them off pre-vote."""
+        clean forward, no 0/0 pooling); callers slice them off pre-vote.
+        Batched callers ride the staging pool via ``_stage_pad``."""
         pad = (-ids.shape[0]) % self.batch_multiple
         if pad:
-            ids = np.pad(np.asarray(ids), ((0, pad), (0, 0)))
-            mask = np.pad(np.asarray(mask), ((0, pad), (0, 0)))
-            mask[-pad:, 0] = 1
+            ids, mask = self._stage_pad(
+                ids, mask, ids.shape[0] + pad, pad_attend=True
+            )
         return ids, mask
 
     def consensus_confidence_tokens(
@@ -784,13 +873,14 @@ class TpuEmbedder:
             ("vote1", ids.shape[0], ids.shape[1], use_fused), ids, mask
         )
         if exe is not None:
+            temp = self._stage_temp(temperature)
             return self._timed_dispatch(
                 label,
                 lambda: exe(
                     self.params,
                     jnp.asarray(ids),
                     jnp.asarray(mask),
-                    jnp.asarray(float(temperature), jnp.float32),
+                    temp,
                 ),
             )
         dev_ids, dev_mask = self.put_batch(jnp.asarray(ids), jnp.asarray(mask))

@@ -8,8 +8,10 @@ vocabulary:
 * ``admission_wait``  — gateway door to admission slot held
 * ``batcher_queue``   — item enqueued to its group taking the device
 * ``pack_plan``       — host-side ragged packing plan (packed path)
-* ``device_dispatch`` — the device executable itself, measured with
-  ``block_until_ready`` at the embedder seam, per (mesh-shape, bucket)
+* ``device_dispatch`` — the device executable itself, measured
+  enqueue-to-ready at the embedder seam (models/dispatch_seam.py: the
+  batcher's waiter thread blocks; direct callers pay an inline
+  bracket), per (mesh-shape, bucket)
 * ``host_tally``      — consensus tally / packed reassembly on host
 * ``upstream_judge``  — judge LLM streaming fan-out
 
@@ -37,6 +39,7 @@ Stdlib-only, dependency-free below ``utils`` like the rest of ``obs/``.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from .histogram import Histogram
@@ -60,10 +63,16 @@ class PhaseAggregator:
     while HTTP phases land from the event loop; each observe is one
     O(1) histogram increment under an uncontended lock."""
 
+    # retained (enqueue, ready) device intervals for the overlap gauge;
+    # a rolling window so the gauge tracks the CURRENT pipelining
+    # behavior, not the process lifetime average
+    INTERVAL_WINDOW = 4096
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._phases: Dict[str, Histogram] = {}
         self._device: Dict[str, Histogram] = {}
+        self._intervals: deque = deque(maxlen=self.INTERVAL_WINDOW)
 
     def observe_phase(self, phase: str, ms: float) -> None:
         with self._lock:
@@ -87,12 +96,30 @@ class PhaseAggregator:
                 phist = self._phases["device_dispatch"] = Histogram()
             phist.observe(ms)
 
+    def observe_device_interval(self, start: float, end: float) -> None:
+        """One device dispatch's (enqueue, ready) interval in
+        ``time.perf_counter`` seconds — the raw material for the
+        ``overlap`` gauge (pipelined dispatches' intervals genuinely
+        overlap; a serialized pipeline's tile end to start)."""
+        with self._lock:
+            self._intervals.append((float(start), float(end)))
+
+    def device_intervals(self) -> List[Tuple[float, float]]:
+        """The retained interval window (tests and the gauge)."""
+        with self._lock:
+            return list(self._intervals)
+
     # -- read side ------------------------------------------------------------
 
     def snapshot(self) -> dict:
         """The /metrics ``phases`` section: per-phase histogram summary
         plus the device share of all attributed time (the figure
-        BENCH_r03 had to hand-derive)."""
+        BENCH_r03 had to hand-derive) and the ``overlap`` gauge
+        (ISSUE 13): device-busy union-interval over wall time across the
+        retained dispatch window.  ~1.0 means pipelined dispatches keep
+        the device continuously busy; a fully serialized pipeline with
+        host work between dispatches reads well below 1.  None until
+        two dispatches have landed (no overlap to speak of)."""
         with self._lock:
             rows = {
                 phase: hist.to_json_obj()
@@ -101,10 +128,17 @@ class PhaseAggregator:
             total = sum(h.sum for h in self._phases.values())
             device = self._phases.get("device_dispatch")
             device_sum = device.sum if device is not None else 0.0
+            intervals = list(self._intervals)
         out: dict = {phase: rows[phase] for phase in PHASES if phase in rows}
         out["device_time_share"] = (
             round(device_sum / total, 4) if total > 0 else None
         )
+        overlap = None
+        if len(intervals) >= 2:
+            wall = max(e for _, e in intervals) - min(s for s, _ in intervals)
+            if wall > 0:
+                overlap = round(min(_union_ms(intervals) / wall, 1.0), 4)
+        out["overlap"] = overlap
         return out
 
     def device_snapshot(self) -> Dict[str, dict]:
@@ -134,6 +168,7 @@ class PhaseAggregator:
         with self._lock:
             self._phases.clear()
             self._device.clear()
+            self._intervals.clear()
 
 
 def _clone(hist: Histogram) -> Histogram:
@@ -153,6 +188,10 @@ def observe_phase(phase: str, ms: float) -> None:
 
 def observe_device(bucket: str, ms: float) -> None:
     _AGG.observe_device(bucket, ms)
+
+
+def observe_device_interval(start: float, end: float) -> None:
+    _AGG.observe_device_interval(start, end)
 
 
 def phases_snapshot() -> dict:

@@ -771,9 +771,11 @@ def build_service(
     # allowed (still logged); production startup refuses them
     embedder = build_embedder(config, allow_synthetic=fake_upstream)
     if embedder is not None:
-        # per-bucket device timing (phases/roofline sections); the knob
-        # exists because the block_until_ready bracket serializes the
-        # dispatch pipeline when METRICS_DEVICE_TIMING=0 matters more
+        # per-bucket device timing (phases/roofline sections), measured
+        # enqueue-to-ready: under the batcher the readiness wait runs on
+        # a waiter thread (models/dispatch_seam.py), so timing no longer
+        # serializes the dispatch pipeline; =0 only darkens the device
+        # rows, roofline attainment and the overlap gauge
         embedder.device_timing = config.metrics_device_timing
     packed_buckets = []
     if embedder is not None and config.warmup:
@@ -957,6 +959,8 @@ def build_service(
             packing_max_segments=config.packing_max_segments,
             prefix_dedup=config.prefix_dedup,
             prefix_dedup_min_chars=config.prefix_dedup_min_chars,
+            host_tokenizer_workers=config.host_tokenizer_workers,
+            staging_buffers=config.staging_buffers,
             embed_cache=embed_cache,
             max_queue_depth=config.admission_max_queue_depth,
             watchdog=watchdog,

@@ -22,15 +22,28 @@ Three work kinds are batched:
                     streams' updates run as one vmapped dispatch
                     (``stream_vote_update_many``).
 
-Dispatches are PIPELINED to ``pipeline_depth`` in flight (default 2): the
-host side of dispatch k+1 (tokenize + buffer staging, a significant slice
-of wall time at large batches) overlaps dispatch k's device execution —
-the same overlap bench.py's async-dispatch throughput loop exploits.  XLA
-orders the device work on its stream, so results are unaffected; arrivals
-while every slot is busy queue and ride the next group.  Utilization
-(queue depth, busy fraction, items-per-dispatch) is exposed through the
-metrics provider hook so the window/batch knobs are tunable from
-``GET /metrics``.
+Dispatches are PIPELINED to ``pipeline_depth`` in flight (default 2), and
+the pipeline is asynchronous end to end (ISSUE 13):
+
+* **submit time** — each item's tokenization (and packed pack-plan) runs
+  in a small host worker pool (``HOST_TOKENIZER_WORKERS``) the moment it
+  is submitted, so ``_dispatch_*`` only concatenates pre-built rows;
+* **dispatch thread** — pads into reusable staging buffers, starts the
+  ``device_put`` (baked batch sharding in mesh mode), and returns as
+  soon as the PJRT call is ENQUEUED (models/dispatch_seam.py) — group
+  k+1's staging genuinely overlaps group k's device execution, even
+  with ``METRICS_DEVICE_TIMING=1``;
+* **waiter thread** — blocks on the enqueued outputs, records the
+  per-bucket device time + the ``overlap`` gauge interval, recycles the
+  staging buffers, and materializes per-item results.  Device faults
+  surface here and feed the same meshfault triage as dispatch-thread
+  ones.
+
+XLA orders the device work on its stream, so results are unaffected;
+arrivals while every slot is busy queue and ride the next group.
+Utilization (queue depth, busy fraction, items-per-dispatch) is exposed
+through the metrics provider hook so the window/batch knobs are tunable
+from ``GET /metrics``.
 """
 
 from __future__ import annotations
@@ -44,11 +57,13 @@ from typing import Optional
 
 import numpy as np
 
+from ..models import dispatch_seam as _seam
+
 
 class _Item:
     __slots__ = (
         "kind", "key", "payload", "future", "deadline", "span",
-        "redispatches", "submitted",
+        "redispatches", "submitted", "prepared",
     )
 
     def __init__(self, kind, key, payload, future, deadline=None, span=None):
@@ -73,6 +88,23 @@ class _Item:
         # (resilience/meshfault.py) — bounded so a fault loop can never
         # recycle one item forever
         self.redispatches = 0
+        # submit-time tokenization (HOST_TOKENIZER_WORKERS): a future
+        # resolving to this item's pre-built rows (padded kinds) or its
+        # packed plan; None when the pool is off or the kind streams
+        self.prepared = None
+
+
+class _StagedGroup:
+    """What the dispatch hop hands the waiter hop: the group's deferred-
+    readiness sink (pending device dispatches + checked-out staging
+    buffers) and the finalize closure that materializes per-item results
+    after readiness."""
+
+    __slots__ = ("sink", "finalize")
+
+    def __init__(self, sink, finalize) -> None:
+        self.sink = sink
+        self.finalize = finalize
 
 
 class DeviceBatcher:
@@ -105,6 +137,8 @@ class DeviceBatcher:
         packing_max_segments: int = 64,
         prefix_dedup: bool = True,
         prefix_dedup_min_chars: int = 48,
+        host_tokenizer_workers: int = 2,
+        staging_buffers: int = 2,
     ) -> None:
         self.embedder = embedder
         self.metrics = metrics
@@ -198,6 +232,30 @@ class DeviceBatcher:
             max_workers=self.pipeline_depth,
             thread_name_prefix="lwc-device",
         )
+        # the readiness waiters (dispatch_seam.py): one hop per in-flight
+        # group blocks on its enqueued outputs OFF the dispatch thread,
+        # so sizing matches the pipeline depth exactly
+        self._waiters = ThreadPoolExecutor(
+            max_workers=self.pipeline_depth,
+            thread_name_prefix="lwc-waiter",
+        )
+        # submit-time tokenization pool (HOST_TOKENIZER_WORKERS; 0 =
+        # tokenize on the dispatch thread, the pre-ISSUE-13 behavior)
+        self.host_tokenizer_workers = max(0, int(host_tokenizer_workers))
+        self._tok_pool = (
+            ThreadPoolExecutor(
+                max_workers=self.host_tokenizer_workers,
+                thread_name_prefix="lwc-hosttok",
+            )
+            if self.host_tokenizer_workers > 0
+            else None
+        )
+        # size the embedder's staging-buffer pool (STAGING_BUFFERS; the
+        # waiter recycles buffers through it at readiness)
+        self.staging_buffers = max(0, int(staging_buffers))
+        pool = getattr(embedder, "staging_pool", None)
+        if pool is not None:
+            pool.per_bucket = self.staging_buffers
         # recent device-dispatch intervals, for the busy-fraction gauge
         self._busy: deque = deque(maxlen=1024)
         # start times of dispatches currently in flight (pipelined: >1)
@@ -366,6 +424,9 @@ class DeviceBatcher:
 
     def close(self) -> None:
         self._executor.shutdown(wait=False)
+        self._waiters.shutdown(wait=False)
+        if self._tok_pool is not None:
+            self._tok_pool.shutdown(wait=False)
 
     # -- overload / lifecycle hooks -------------------------------------------
 
@@ -429,6 +490,15 @@ class DeviceBatcher:
             else 0.0,
             "window_ms": self.window_ms,
             "max_batch": self.max_batch,
+            # host<->device overlap machinery (ISSUE 13): submit-time
+            # tokenization pool size and the embedder's staging-buffer
+            # reuse counters (None when the embedder has no pool)
+            "host_tokenizer_workers": self.host_tokenizer_workers,
+            "staging": (
+                self.embedder.staging_pool.stats()
+                if getattr(self.embedder, "staging_pool", None) is not None
+                else None
+            ),
             "max_queue_depth": self.max_queue_depth,
             "shed_queue_full": self.shed_queue_full,
             "shed_deadline": self.shed_deadline,
@@ -500,9 +570,19 @@ class DeviceBatcher:
 
         loop = asyncio.get_running_loop()
         future = loop.create_future()
-        self._pending.append(
-            _Item(kind, key, payload, future, current_deadline(), span)
-        )
+        item = _Item(kind, key, payload, future, current_deadline(), span)
+        if self._tok_pool is not None and kind in ("embed", "consensus"):
+            # submit-time tokenization: the item's rows (or packed plan)
+            # build on the host pool NOW, overlapping earlier groups'
+            # device time; tokenizer errors park in the future and
+            # re-raise on the dispatch thread, same path as before
+            try:
+                item.prepared = self._tok_pool.submit(
+                    self._prepare_item, kind, key, payload
+                )
+            except RuntimeError:  # pool shut down mid-close
+                item.prepared = None
+        self._pending.append(item)
         if self._flusher is None or self._flusher.done():
             self._flusher = loop.create_task(self._drain())
         elif self._wake is not None:
@@ -715,8 +795,16 @@ class DeviceBatcher:
             else None
         )
         try:
-            results = await loop.run_in_executor(
+            staged = await loop.run_in_executor(
                 self._executor, self._dispatch, group
+            )
+            # readiness moved OFF the dispatch thread (ISSUE 13): the
+            # hop above returns at enqueue, freeing its executor worker
+            # to stage the next group; this waiter hop blocks on the
+            # enqueued outputs, records device time + overlap intervals,
+            # and materializes per-item results
+            results = await loop.run_in_executor(
+                self._waiters, self._finalize_group, staged
             )
         except Exception as e:
             error = True
@@ -724,7 +812,10 @@ class DeviceBatcher:
             # fault re-queues the group's live items (after a downsize,
             # when the fault is persistent) instead of failing them;
             # ordinary application errors — and anything raised by the
-            # CPU twin — keep the fail-the-group path byte-for-byte
+            # CPU twin — keep the fail-the-group path byte-for-byte.
+            # Faults now surface on EITHER hop — inject/staging errors on
+            # the dispatch thread, device faults at the waiter where
+            # readiness reports them — and both land here
             kind = (
                 self.meshfault.classify(e)
                 if self.meshfault is not None and not self._use_fallback
@@ -943,7 +1034,11 @@ class DeviceBatcher:
 
     # -- dispatch implementations (device thread) ------------------------------
 
-    def _dispatch(self, group: list) -> list:
+    def _dispatch(self, group: list):
+        """Stage-and-enqueue hop: returns a plain result list on the
+        fallback paths, or a ``_StagedGroup`` whose device work is
+        ENQUEUED but not awaited — ``_finalize_group`` (waiter hop)
+        finishes it."""
         if group[0].key and group[0].key[0] == "packed":
             fn = self._dispatch_packed
         else:
@@ -953,81 +1048,183 @@ class DeviceBatcher:
             if self.fallback_context is not None:
                 # jax.default_device scope: the fallback's computations
                 # must stage on the CPU, never queue behind the wedged
-                # device dispatch the watchdog tripped on
+                # device dispatch the watchdog tripped on.  No deferral:
+                # the twin's results materialize inline, inside the scope
                 with self.fallback_context():
-                    return fn(group, self.fallback_embedder)
-            return fn(group, self.fallback_embedder)
+                    return fn(group, self.fallback_embedder)()
+            return fn(group, self.fallback_embedder)()
+        sink = _seam.DispatchSink()
         if self.meshfault is not None:
             # shared side of the shape gate: this dispatch's embedder
-            # reads are serialized against downsize/try_recover re-shards
-            # (the executor has pipeline_depth workers, so "run the
-            # re-shard on the executor" alone would NOT serialize them).
-            # The DEVICE_FAULT_PLAN seam injects here, on the dispatch
-            # thread where a real device failure would raise; the
-            # CPU-twin branch above never injects (the plan models the
-            # device tier)
+            # reads (params, batch_multiple, shardings) are serialized
+            # against downsize/try_recover re-shards (the executor has
+            # pipeline_depth workers, so "run the re-shard on the
+            # executor" alone would NOT serialize them).  The gate
+            # releases at ENQUEUE: the PJRT call has captured its
+            # buffers by then, so a re-shard swapping ``params`` cannot
+            # tear in-flight device work — faults from that work surface
+            # at the waiter and classify exactly like dispatch-thread
+            # ones.  The DEVICE_FAULT_PLAN seam injects here, on the
+            # dispatch thread where a real staging failure would raise;
+            # the CPU-twin branch above never injects (the plan models
+            # the device tier)
             with self.meshfault.dispatch_guard():
                 self.meshfault.maybe_inject()
-                results = fn(group, self.embedder)
-            self.meshfault.note_dispatch_ok()
-            return results
-        return fn(group, self.embedder)
+                with _seam.deferred_readiness(sink):
+                    finalize = fn(group, self.embedder)
+        else:
+            with _seam.deferred_readiness(sink):
+                finalize = fn(group, self.embedder)
+        return _StagedGroup(sink, finalize)
 
-    def _dispatch_embed(self, group: list, embedder) -> list:
-        max_tokens = group[0].payload[1]
-        texts: list = []
-        counts = []
+    def _finalize_group(self, staged):
+        """Waiter hop (lwc-waiter thread): block on the group's enqueued
+        outputs, record per-bucket device time + the overlap gauge's
+        (enqueue, ready) intervals, recycle staging buffers, then run
+        the finalize closure (np conversions + per-item splits).  Device
+        faults raise here and ride ``_run_group``'s triage."""
+        if not isinstance(staged, _StagedGroup):
+            return staged  # fallback path: already final
+        from ..obs import phases as _phases
+
+        pool = getattr(self.embedder, "staging_pool", None)
+        _seam.drain_sink(
+            staged.sink,
+            observe_device=_phases.observe_device,
+            observe_interval=_phases.observe_device_interval,
+            release=pool.release if pool is not None else None,
+        )
+        results = staged.finalize()
+        if self.meshfault is not None and not self._use_fallback:
+            # the success note moves with readiness: a dispatch only
+            # resets the transient-fault streak once its device work
+            # actually completed, not merely enqueued
+            self.meshfault.note_dispatch_ok()
+        return results
+
+    def _prepare_item(self, kind, key, payload):
+        """Submit-time host work for one item (lwc-hosttok thread):
+        pre-built padded rows for embed/consensus items, or the local-
+        index packed plan for packed-key items.  Always runs against the
+        PRIMARY embedder's tokenizer; the dispatch falls back to inline
+        tokenization when it is serving the CPU twin."""
+        if key and key[0] == "packed":
+            return self._plan_packed_payload(kind, payload, self.embedder)
+        if kind == "embed":
+            texts, cap = payload
+            return self.embedder.tokenize(texts, cap)
+        texts, _temperature = payload
+        return self.embedder.tokenize(texts)
+
+    def _prepared_rows(self, group: list, embedder):
+        """Concatenate the group's submit-time tokenized rows into the
+        batch group-level ``tokenize`` would have produced: each item's
+        rows are padded from its own seq bucket out to the group's
+        (fill = the tokenizer pad id, mask 0 — the exact background
+        ``encode_batch`` writes), so the result is byte-identical to
+        tokenizing the whole group at once.  None when any item lacks
+        prepared rows (pool off, CPU twin, mid-close)."""
+        if embedder is not self.embedder:
+            return None
+        rows = []
         for item in group:
-            t, _ = item.payload
-            texts.extend(t)
-            counts.append(len(t))
-        ids, mask = embedder.tokenize(texts, max_tokens)
+            fut = item.prepared
+            if fut is None:
+                return None
+            rows.append(fut.result())  # re-raises tokenizer errors
+        width = max(ids.shape[1] for ids, _ in rows)
+        if len(rows) == 1:
+            return rows[0]
+        pad_id = int(
+            getattr(getattr(embedder, "tokenizer", None), "pad_id", 0) or 0
+        )
+        ids_parts, mask_parts = [], []
+        for ids, mask in rows:
+            gap = width - ids.shape[1]
+            if gap:
+                ids = np.pad(
+                    ids, ((0, 0), (0, gap)), constant_values=pad_id
+                )
+                mask = np.pad(mask, ((0, 0), (0, gap)))
+            ids_parts.append(ids)
+            mask_parts.append(mask)
+        return np.concatenate(ids_parts), np.concatenate(mask_parts)
+
+    def _dispatch_embed(self, group: list, embedder):
+        max_tokens = group[0].payload[1]
+        counts = [len(item.payload[0]) for item in group]
+        prepared = self._prepared_rows(group, embedder)
+        if prepared is not None:
+            ids, mask = prepared
+        else:
+            texts = [t for item in group for t in item.payload[0]]
+            ids, mask = embedder.tokenize(texts, max_tokens)
         self._count_padded(embedder, ids, mask)
         emb = embedder.embed_tokens(ids, mask)
         tokens = mask.sum(axis=1)
-        out = []
-        start = 0
-        for count in counts:
-            # per-ROW token counts (not the summed total): embed() needs
-            # row granularity for the per-row memoization path and sums
-            # for the public (emb, total_tokens) contract
-            out.append(
-                (
-                    emb[start : start + count],
-                    tokens[start : start + count],
-                )
-            )
-            start += count
-        return out
 
-    def _dispatch_consensus(self, group: list, embedder) -> list:
+        def finalize() -> list:
+            # waiter hop: emb materializes AFTER readiness (under the
+            # deferred scope embed_tokens handed back the device array)
+            emb_np = np.asarray(emb)
+            out = []
+            start = 0
+            for count in counts:
+                # per-ROW token counts (not the summed total): embed()
+                # needs row granularity for the per-row memoization path
+                # and sums for the public (emb, total_tokens) contract
+                out.append(
+                    (
+                        emb_np[start : start + count],
+                        tokens[start : start + count],
+                    )
+                )
+                start += count
+            return out
+
+        return finalize
+
+    def _dispatch_consensus(self, group: list, embedder):
         texts0, temperature = group[0].payload
         n = len(texts0)
+        prepared = self._prepared_rows(group, embedder)
         if len(group) == 1:
-            ids, mask = embedder.tokenize(texts0)
+            if prepared is not None:
+                ids, mask = prepared
+            else:
+                ids, mask = embedder.tokenize(texts0)
             self._pad_real_tokens += int(mask.sum())
             self._pad_slot_tokens += int(ids.size)
-            conf = np.asarray(
-                embedder.consensus_confidence_tokens(
-                    ids, mask, temperature
-                )
+            conf = embedder.consensus_confidence_tokens(
+                ids, mask, temperature
             )
-            return [(conf, int(mask.sum()))]
-        all_texts = [t for item in group for t in item.payload[0]]
-        ids, mask = embedder.tokenize(all_texts)
+            tok = int(mask.sum())
+
+            def finalize_one() -> list:
+                return [(np.asarray(conf), tok)]
+
+            return finalize_one
+        if prepared is not None:
+            ids, mask = prepared
+        else:
+            all_texts = [t for item in group for t in item.payload[0]]
+            ids, mask = embedder.tokenize(all_texts)
         r = len(group)
         from ..utils import next_pow2
 
         # the grouped dispatch pads the request dim to its pow2 bucket
         self._pad_real_tokens += int(mask.sum())
         self._pad_slot_tokens += int(next_pow2(r) * n * ids.shape[1])
-        conf = np.asarray(
-            embedder.consensus_confidence_tokens_many(
-                ids.reshape(r, n, -1), mask.reshape(r, n, -1), temperature
-            )
+        conf = embedder.consensus_confidence_tokens_many(
+            ids.reshape(r, n, -1), mask.reshape(r, n, -1), temperature
         )
         tokens = mask.reshape(r, n, -1).sum(axis=(1, 2))
-        return [(conf[i], int(tokens[i])) for i in range(r)]
+
+        def finalize() -> list:
+            conf_np = np.asarray(conf)
+            return [(conf_np[i], int(tokens[i])) for i in range(r)]
+
+        return finalize
 
     def _count_padded(self, embedder, ids, mask) -> None:
         """Padded-path efficiency accounting for an embed dispatch: real
@@ -1047,21 +1244,22 @@ class DeviceBatcher:
 
     # -- packed (continuous-batching) dispatch --------------------------------
 
-    def _dispatch_packed(self, group: list, embedder) -> list:
+    def _dispatch_packed(self, group: list, embedder):
         """One mixed group (embed + consensus items, any N, any cap) ->
         per-item results through the ragged segment-id layout.
 
-        Per item: tokenize ragged segments under the item's own cap
-        (consensus items optionally splitting into ONE shared-prefix
-        segment + N suffix segments), first-fit pack every segment in the
-        group into ("packed", B, L, K) bucket calls, run
-        ``embedder.embed_packed`` per call, then reassemble: embed items
-        gather their per-text vectors; consensus items compose candidate
-        vectors (prefix-weighted when deduped) and vote ON HOST
-        (``packing.consensus_vote_np`` — numerics-matched to the device
-        vote) so mixed-N requests share a dispatch without per-N jit
-        specializations.  Items whose sequences exceed the packed row
-        fall back to their padded dispatch, inside this same group."""
+        Stage (dispatch thread): collect each item's pack plan — built at
+        submit time on the host pool when possible — first-fit pack every
+        segment in the group into ("packed", B, L, K) bucket calls, and
+        ENQUEUE ``embedder.embed_packed`` per call.  Finalize (waiter
+        thread, after readiness): materialize segment vectors, then
+        reassemble — embed items gather their per-text vectors; consensus
+        items compose candidate vectors (prefix-weighted when deduped)
+        and vote ON HOST (``packing.consensus_vote_np`` — numerics-
+        matched to the device vote) so mixed-N requests share a dispatch
+        without per-N jit specializations.  Items whose sequences exceed
+        the packed row fall back to their padded dispatch, staged inside
+        this same group."""
         from . import packing as _packing
 
         if not (
@@ -1072,28 +1270,40 @@ class DeviceBatcher:
             # mid-swap: serve every item through its padded path, one by
             # one (first-class mesh embedders pack fine and never land
             # here)
-            return [self._packed_item_fallback(item, embedder) for item in group]
+            staged = [
+                self._packed_item_fallback(item, embedder)
+                for item in group
+            ]
+            return lambda: [(np.asarray(a), t) for a, t in staged]
         from ..obs import phases as _phases
 
         row_tokens = self.packing_row_tokens
-        seg_cap = min(row_tokens, embedder.max_tokens)
         segments: list = []  # ragged int32 token rows, group-global
-        plans: list = []  # one assembly plan per item
         # pack_plan phase: ragged tokenization + first-fit packing (the
-        # host work BEFORE any device call); runs on the executor
-        # thread, so it reports to the lock-guarded global aggregator
-        # and stamps each item's batcher span (annotate is a plain dict
-        # update — no span creation off the event loop)
+        # host work BEFORE any device call); submit-time plans make the
+        # per-item loop a rebase, inline planning covers the rest.  Runs
+        # on the executor thread, so it reports to the lock-guarded
+        # global aggregator and stamps each item's batcher span
+        # (annotate is a plain dict update — no span creation off the
+        # event loop)
         t_plan = time.perf_counter()
-        for item in group:
-            plans.append(
-                self._plan_packed_item(
-                    item, embedder, segments, seg_cap, row_tokens
-                )
-            )
+        plans = [
+            self._plan_packed_item(item, embedder, segments)
+            for item in group
+        ]
         plan_ms = (time.perf_counter() - t_plan) * 1e3
-        results: list = [None] * len(group)
+        # oversized items dispatch their padded path NOW, on the same
+        # thread and inside the same guard/deferred scope as the packed
+        # calls; their host fetches ride finalize with everything else
+        fallback_staged: dict = {}
+        for i, plan in enumerate(plans):
+            if plan[0] == "fallback":
+                self.packed_fallback_items += 1
+                fallback_staged[i] = self._packed_item_fallback(
+                    group[i], embedder
+                )
         seg_vecs: list = [None] * len(segments)
+        call_outs: list = []  # (call, enqueued device out) pairs
         if segments:
             t_plan = time.perf_counter()
             calls = _packing.build_calls(
@@ -1114,46 +1324,82 @@ class DeviceBatcher:
                 self._packed_occupancy[b] = (
                     self._packed_occupancy.get(b, 0) + 1
                 )
-                for si, (r, slot) in call.slots.items():
-                    seg_vecs[si] = np.asarray(out[r, slot], np.float32)
-        # host_tally phase: per-item reassembly + the host-side vote
-        # (packing.consensus_vote_np)
-        t_tally = time.perf_counter()
-        for i, (item, plan) in enumerate(zip(group, plans)):
-            results[i] = self._assemble_packed_item(
-                item, plan, segments, seg_vecs, embedder
-            )
-        tally_ms = (time.perf_counter() - t_tally) * 1e3
+                call_outs.append((call, out))
         _phases.observe_phase("pack_plan", plan_ms)
-        _phases.observe_phase("host_tally", tally_ms)
         share_plan = plan_ms / len(group)
-        share_tally = tally_ms / len(group)
         for item in group:
             if item.span is not None:
-                item.span.annotate(
-                    pack_plan_ms=round(share_plan, 3),
-                    host_tally_ms=round(share_tally, 3),
-                )
-        return results
+                item.span.annotate(pack_plan_ms=round(share_plan, 3))
 
-    def _plan_packed_item(
-        self, item, embedder, segments: list, seg_cap: int, row_tokens: int
-    ):
-        """Tokenize one item into group-global segments and return its
-        assembly plan; oversized items plan as ("fallback",)."""
+        def finalize() -> list:
+            for call, out in call_outs:
+                out_np = np.asarray(out, np.float32)
+                for si, (r, slot) in call.slots.items():
+                    seg_vecs[si] = out_np[r, slot]
+            # host_tally phase: per-item reassembly + the host-side vote
+            # (packing.consensus_vote_np) — waiter-thread work that
+            # overlaps the NEXT group's staging and device time
+            t_tally = time.perf_counter()
+            results: list = [None] * len(group)
+            for i, (item, plan) in enumerate(zip(group, plans)):
+                if plan[0] == "fallback":
+                    a, t = fallback_staged[i]
+                    results[i] = (np.asarray(a), t)
+                else:
+                    results[i] = self._assemble_packed_item(
+                        item, plan, segments, seg_vecs, embedder
+                    )
+            tally_ms = (time.perf_counter() - t_tally) * 1e3
+            _phases.observe_phase("host_tally", tally_ms)
+            share_tally = tally_ms / len(group)
+            for item in group:
+                if item.span is not None:
+                    item.span.annotate(host_tally_ms=round(share_tally, 3))
+            return results
+
+        return finalize
+
+    def _plan_packed_item(self, item, embedder, segments: list):
+        """One item's group-global assembly plan: consume the submit-time
+        plan when it was built against THIS embedder (the CPU twin's
+        tokenizer may differ), else plan inline; extend the group
+        segments and apply the dedup counters the pure planner deferred."""
+        if embedder is self.embedder and item.prepared is not None:
+            plan, rows, stats = item.prepared.result()
+        else:
+            plan, rows, stats = self._plan_packed_payload(
+                item.kind, item.payload, embedder
+            )
+        base = len(segments)
+        segments.extend(rows)
+        if stats is not None:
+            _, hits, saved = stats
+            self.prefix_dedup_hits += hits
+            self.prefix_dedup_tokens_saved += saved
+        return self._rebase_plan(plan, base)
+
+    def _plan_packed_payload(self, kind, payload, embedder):
+        """Pure pack planning for one item's payload -> (local plan,
+        ragged rows, dedup-stats delta).  Plan segment indices are
+        0-based relative to ``rows`` so the plan can build at SUBMIT time
+        (host pool), before the item's position in any dispatch group is
+        known; ``_plan_packed_item`` rebases it.  Counters are applied
+        only when the plan is consumed, so a shed item's speculative plan
+        costs nothing observable.  Oversized items plan as
+        ("fallback",)."""
         from . import packing as _packing
 
-        if item.kind == "embed":
-            texts, cap = item.payload
+        row_tokens = self.packing_row_tokens
+        seg_cap = min(row_tokens, embedder.max_tokens)
+        if kind == "embed":
+            texts, cap = payload
             rows = embedder.tokenize_ragged(
                 texts, min(cap, seg_cap) if cap else seg_cap
             )
             if any(not 0 < len(r) <= row_tokens for r in rows):
-                return ("fallback",)
-            base = len(segments)
-            segments.extend(rows)
-            return ("embed", list(range(base, base + len(rows))))
-        texts, temperature = item.payload
+                return (("fallback",), [], None)
+            return (("embed", list(range(len(rows)))), rows, None)
+        texts, temperature = payload
         prefix = (
             _packing.shared_prefix(texts, self.prefix_dedup_min_chars)
             if self.prefix_dedup
@@ -1170,36 +1416,55 @@ class DeviceBatcher:
             if len(rows[0]) >= 4 and all(
                 0 < len(r) <= row_tokens for r in rows
             ):
-                base = len(segments)
-                segments.extend(rows)
-                seg_iter = iter(range(base + 1, base + len(rows)))
+                seg_iter = iter(range(1, len(rows)))
                 suffix_segs = [
                     next(seg_iter) if s else None for s in parts[1:]
                 ]
-                self.prefix_dedup_hits += len(texts) - 1
-                self.prefix_dedup_tokens_saved += (
-                    len(texts) - 1
-                ) * len(rows[0])
-                return ("consensus_dedup", base, suffix_segs, temperature)
+                stats = (
+                    "dedup",
+                    len(texts) - 1,
+                    (len(texts) - 1) * len(rows[0]),
+                )
+                return (
+                    ("consensus_dedup", 0, suffix_segs, temperature),
+                    rows,
+                    stats,
+                )
         rows = embedder.tokenize_ragged(texts, seg_cap)
         if any(not 0 < len(r) <= row_tokens for r in rows):
-            return ("fallback",)
-        base = len(segments)
-        segments.extend(rows)
+            return (("fallback",), [], None)
         return (
-            "consensus",
-            list(range(base, base + len(rows))),
-            temperature,
+            ("consensus", list(range(len(rows))), temperature),
+            rows,
+            None,
         )
+
+    @staticmethod
+    def _rebase_plan(plan, base: int):
+        """Shift a local-index pack plan's segment indices by ``base``
+        (the group-global offset its rows landed at)."""
+        if plan[0] == "embed":
+            return ("embed", [base + i for i in plan[1]])
+        if plan[0] == "consensus_dedup":
+            _, prefix_idx, suffix_segs, temperature = plan
+            return (
+                "consensus_dedup",
+                base + prefix_idx,
+                [
+                    base + si if si is not None else None
+                    for si in suffix_segs
+                ],
+                temperature,
+            )
+        if plan[0] == "consensus":
+            return ("consensus", [base + i for i in plan[1]], plan[2])
+        return plan  # ("fallback",)
 
     def _assemble_packed_item(
         self, item, plan, segments: list, seg_vecs: list, embedder
     ):
         from . import packing as _packing
 
-        if plan[0] == "fallback":
-            self.packed_fallback_items += 1
-            return self._packed_item_fallback(item, embedder)
         if plan[0] == "embed":
             idxs = plan[1]
             emb = np.stack([seg_vecs[i] for i in idxs]).astype(
@@ -1233,8 +1498,10 @@ class DeviceBatcher:
         return (conf, int(sum(len(segments[i]) for i in idxs)))
 
     def _packed_item_fallback(self, item, embedder):
-        """Serve one packed-key item through its padded dispatch (the
-        packed row cannot hold it, or the embedder cannot pack)."""
+        """Stage one packed-key item through its padded dispatch (the
+        packed row cannot hold it, or the embedder cannot pack).  The
+        returned (handle, tokens) pair is host-materialized by the
+        caller's finalize closure, after readiness."""
         if item.kind == "embed":
             texts, cap = item.payload
             ids, mask = embedder.tokenize(texts, cap)
@@ -1245,21 +1512,25 @@ class DeviceBatcher:
         ids, mask = embedder.tokenize(texts)
         self._pad_real_tokens += int(mask.sum())
         self._pad_slot_tokens += int(ids.size)
-        conf = np.asarray(
-            embedder.consensus_confidence_tokens(ids, mask, temperature)
-        )
+        conf = embedder.consensus_confidence_tokens(ids, mask, temperature)
         return (conf, int(mask.sum()))
 
-    def _dispatch_stream(self, group: list, embedder) -> list:
+    def _dispatch_stream(self, group: list, embedder):
         if len(group) == 1:
             text, buf, valid, position, temperature, want = group[0].payload
             out_buf, out_valid, conf = embedder.stream_vote_update(
                 text, buf, valid, position, temperature
             )
-            # fetch here, on the device thread — a device-resident conf
-            # would make the caller's np.asarray stall the event loop
-            # for a link round-trip per update
-            return [(out_buf, out_valid, np.asarray(conf) if want else None)]
+
+            def finalize_one() -> list:
+                # fetch here, on the waiter thread — a device-resident
+                # conf would make the caller's np.asarray stall the
+                # event loop for a link round-trip per update
+                return [
+                    (out_buf, out_valid, np.asarray(conf) if want else None)
+                ]
+
+            return finalize_one
         texts = [item.payload[0] for item in group]
         bufs = [item.payload[1] for item in group]
         valids = [item.payload[2] for item in group]
@@ -1269,17 +1540,22 @@ class DeviceBatcher:
         out_bufs, out_valids, confs = embedder.stream_vote_update_many(
             texts, bufs, valids, positions, temperature
         )
-        # fetch ALL wanted confidences in ONE transfer here: every stream
-        # np.asarray's its own confidence right after this returns, and
-        # R separate slice fetches would re-serialize the round-trips
-        # the batching just fused (R x link RTT per dispatch).  bufs /
-        # valids stay device-resident — nobody reads them on host.
-        confs_host = np.asarray(confs) if any(wants) else None
-        return [
-            (
-                out_bufs[i],
-                out_valids[i],
-                confs_host[i] if wants[i] else None,
-            )
-            for i in range(len(group))
-        ]
+
+        def finalize() -> list:
+            # fetch ALL wanted confidences in ONE transfer here, on the
+            # waiter thread: every stream np.asarray's its own
+            # confidence right after this returns, and R separate slice
+            # fetches would re-serialize the round-trips the batching
+            # just fused (R x link RTT per dispatch).  bufs / valids
+            # stay device-resident — nobody reads them on host.
+            confs_host = np.asarray(confs) if any(wants) else None
+            return [
+                (
+                    out_bufs[i],
+                    out_valids[i],
+                    confs_host[i] if wants[i] else None,
+                )
+                for i in range(len(group))
+            ]
+
+        return finalize

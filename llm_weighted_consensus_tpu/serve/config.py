@@ -95,7 +95,19 @@ TPU additions:
   chunk).  Default 64.
 * ``BATCH_PIPELINE`` — device dispatches allowed in flight concurrently
   (the host side of batch k+1 overlaps batch k's device execution).
-  Default 2; 1 = fully serialized.
+  The overlap holds with device timing on too: the dispatch thread
+  returns at PJRT enqueue and a waiter thread records readiness
+  (models/dispatch_seam.py).  Default 2; 1 = fully serialized.
+* ``HOST_TOKENIZER_WORKERS`` — host threads tokenizing (and pack-
+  planning) each item at SUBMIT time, so ``_dispatch_*`` only
+  concatenates pre-built rows and group k+1's tokenization never rides
+  the dispatch thread behind group k.  ``0`` tokenizes on the dispatch
+  thread (the pre-overlap behavior).  Default 2.
+* ``STAGING_BUFFERS`` — reusable host staging buffers kept per
+  (shape, dtype) bucket for the padded dispatch paths; the batcher's
+  waiter recycles each buffer once its transfer is ready instead of
+  allocating fresh ``np.pad`` copies per dispatch.  ``0`` disables
+  reuse.  Default 2.
 * ``WARMUP`` — consensus shapes to pre-compile at startup, e.g.
   ``64x112,64x128`` (``NxS`` pairs): the first request at a shape
   otherwise pays a multi-second jit compile (each (N, seq-bucket) is
@@ -306,11 +318,15 @@ Performance observability (obs/phases.py, obs/histogram.py,
 analysis/roofline.py — DESIGN.md "Performance observability"):
 
 * ``METRICS_DEVICE_TIMING`` — per-bucket device-time measurement at the
-  embedder seam: every dispatch is bracketed with ``block_until_ready``
-  and lands in the ``phases`` / ``roofline`` sections of ``GET /metrics``
-  keyed by its (mesh-shape, bucket) label.  Default on; ``0`` disables
-  the bracket (dispatches return dispatch-async again, device rows and
-  roofline attainment go dark, the other phases keep reporting).
+  embedder seam: every dispatch is timed enqueue-to-ready and lands in
+  the ``phases`` / ``roofline`` sections of ``GET /metrics`` keyed by
+  its (mesh-shape, bucket) label, plus the ``overlap`` gauge (device-
+  busy union-interval over wall time across recent dispatches).  Under
+  the batcher the readiness wait runs on a waiter thread
+  (models/dispatch_seam.py), so timing does NOT serialize the dispatch
+  pipeline; direct embedder callers pay an inline bracket.  Default on;
+  ``0`` skips the recording (device rows, roofline attainment and the
+  overlap gauge go dark, the other phases keep reporting).
   ``GET /metrics?format=prometheus`` renders the same data as
   OpenMetrics text with trace-id exemplars on the hot series.
 
@@ -556,6 +572,10 @@ class Config:
     batch_pipeline: int = 2
     # encoder rows per dispatch (bursts chunk into overlappable pieces)
     batch_max_rows: int = 512
+    # submit-time tokenization pool (0 = tokenize on dispatch thread)
+    host_tokenizer_workers: int = 2
+    # reusable host staging buffers per (shape, dtype); 0 = no reuse
+    staging_buffers: int = 2
     # continuous batching (serve/packing.py): ragged segment-id packing
     # on the embed/consensus device path; off = legacy padded dispatch
     packing_enabled: bool = False
@@ -636,9 +656,10 @@ class Config:
     trace_enabled: bool = False
     trace_ring: int = 256
     trace_dir: Optional[str] = None
-    # per-bucket device timing (block_until_ready bracket at the
-    # embedder seam) feeding the phases/roofline metrics sections;
-    # METRICS_DEVICE_TIMING=0 returns dispatches to dispatch-async
+    # per-bucket device timing (enqueue-to-ready at the embedder seam;
+    # waiter-thread readiness under the batcher, inline bracket for
+    # direct callers) feeding the phases/roofline metrics sections;
+    # METRICS_DEVICE_TIMING=0 skips the recording entirely
     metrics_device_timing: bool = True
     # consensus-quality observability (obs/quality.py): drift-window
     # size and the agreement/calibration drop that flags a judge
@@ -729,6 +750,10 @@ class Config:
             batch_max=int(env.get("BATCH_MAX", 64)),
             batch_pipeline=max(1, int(env.get("BATCH_PIPELINE", 2))),
             batch_max_rows=max(1, int(env.get("BATCH_MAX_ROWS", 512))),
+            host_tokenizer_workers=_non_negative_int(
+                env, "HOST_TOKENIZER_WORKERS", 2
+            ),
+            staging_buffers=_non_negative_int(env, "STAGING_BUFFERS", 2),
             packing_enabled=env_truthy(env.get("PACKING_ENABLED", "0")),
             packing_row_tokens=max(
                 16, int(env.get("PACKING_ROW_TOKENS", 512))

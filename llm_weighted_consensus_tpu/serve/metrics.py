@@ -250,7 +250,7 @@ def render_prometheus(metrics: Metrics) -> str:
     lines += prom_family(
         "lwc_device_latency_ms",
         "histogram",
-        "block_until_ready device time per (mesh-shape, bucket).",
+        "Enqueue-to-ready device time per (mesh-shape, bucket).",
     )
     for bucket, hist in sorted(device_hists.items()):
         lines += _render_hist("lwc_device_latency_ms", "bucket", bucket, hist)

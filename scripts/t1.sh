@@ -19,6 +19,11 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_meshfault.py
 # ledger, the JUDGE_BIAS_PLAN drill, and the ledger→training round trip
 # must fail tier-1 by name even if collection of the glob above breaks.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_quality.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_q=$?; [ $rc -eq 0 ] && rc=$rc_q; \
+# host<->device overlap tests, explicitly: the deferred-readiness seam
+# (waiter-vs-bracket device-time parity, the slow-fake-device pipelining
+# drill, the overlap gauge, staging-pool recycling) must fail tier-1 by
+# name even if collection of the glob above breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_perfobs.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_po=$?; [ $rc -eq 0 ] && rc=$rc_po; \
 # analysis gate, explicitly: tests/test_analysis.py runs the same checker
 # under pytest, but naming the CLI here means a lint finding, a jaxpr
 # serving-path regression, or a mesh-audit failure (sharding coverage /

@@ -1,0 +1,18 @@
+"""LWC013 violating fixture: blocking readiness on the dispatch path —
+the pipeline silently re-serializes behind each bracket."""
+
+import time
+
+import jax
+
+
+def timed_dispatch(fn):
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)  # blocks the dispatch thread
+    return out, time.perf_counter() - t0
+
+
+def fetch_result(out):
+    # method-call form of the same blocking readiness wait
+    return out.block_until_ready()

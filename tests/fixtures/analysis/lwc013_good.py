@@ -1,0 +1,25 @@
+"""LWC013 conforming fixture: the dispatch path defers readiness to a
+sink record; only the sanctioned waiter symbol blocks."""
+
+import time
+
+import jax
+
+
+def wait_device_ready(out):
+    # the ONE sanctioned blocking readiness call (waiter threads only)
+    jax.block_until_ready(out)
+
+
+def timed_dispatch(fn, sink):
+    t0 = time.perf_counter()
+    out = fn()
+    # enqueue-and-return: the waiter blocks later, off this thread
+    sink.append((t0, out, wait_device_ready))
+    return out
+
+
+def drain(sink):
+    for t0, out, wait in sink:
+        wait(out)
+    sink.clear()

@@ -49,6 +49,7 @@ EXPECTED_BAD = {
     "LWC011": 2,  # undocumented from_env knob + stale README token
     "LWC012": 5,  # undeclared family + dead registry row + non-literal
     # name + the _total-suffixed counter header (undeclared + dead row)
+    "LWC013": 2,  # jax.block_until_ready + .block_until_ready() method
 }
 
 

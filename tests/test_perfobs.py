@@ -143,7 +143,7 @@ def test_aggregator_snapshot_orders_phases_and_computes_device_share():
     agg.observe_phase("batcher_queue", 10.0)
     agg.observe_device("vote1(n=8,s=16)", 60.0)  # also device_dispatch
     snap = agg.snapshot()
-    keys = [k for k in snap if k != "device_time_share"]
+    keys = [k for k in snap if k not in ("device_time_share", "overlap")]
     assert keys == [
         "batcher_queue", "device_dispatch", "upstream_judge"
     ]  # PHASES order, only observed phases
@@ -160,6 +160,256 @@ def test_interval_union_attributes_concurrent_work_once():
     assert _union_ms([(0.0, 10.0), (5.0, 15.0)]) == pytest.approx(15.0)
     assert _union_ms([(0.0, 5.0), (10.0, 12.0)]) == pytest.approx(7.0)
     assert _union_ms([]) == 0.0
+
+
+# -- host<->device overlap (ISSUE 13) -----------------------------------------
+
+
+def test_overlap_gauge_from_device_intervals():
+    agg = PhaseAggregator()
+    assert agg.snapshot()["overlap"] is None
+    agg.observe_device_interval(0.0, 1.0)
+    assert agg.snapshot()["overlap"] is None  # one dispatch: undefined
+    agg.observe_device_interval(0.5, 1.5)  # pipelined: tiles the wall
+    assert agg.snapshot()["overlap"] == pytest.approx(1.0)
+    agg.observe_device_interval(2.5, 3.0)  # a host-side gap opens
+    assert agg.snapshot()["overlap"] == pytest.approx(2.0 / 3.0, abs=1e-3)
+    agg.reset()
+    assert agg.snapshot()["overlap"] is None
+
+
+def test_staging_pool_reuses_buffers_per_shape():
+    from llm_weighted_consensus_tpu.models.dispatch_seam import StagingPool
+
+    pool = StagingPool(per_bucket=1)
+    a = pool.acquire((4, 8), np.int32)
+    pool.release(a)
+    b = pool.acquire((4, 8), np.int32)
+    assert b is a and pool.hits == 1
+    c = pool.acquire((4, 8), np.int32)  # free list empty -> fresh
+    assert c is not a and pool.misses == 2
+    pool.release(b)
+    pool.release(c)  # capacity 1 per bucket: the second drop is let go
+    assert pool.stats()["buckets"] == 1
+    d = pool.acquire((2, 8), np.int32)  # different shape, own bucket
+    assert d.shape == (2, 8) and pool.misses == 3
+    assert not StagingPool(per_bucket=0).enabled
+
+
+def test_deferred_readiness_scopes_to_the_thread_and_nests():
+    from llm_weighted_consensus_tpu.models import dispatch_seam as seam
+
+    assert seam.active_sink() is None
+    sink = seam.DispatchSink()
+    with seam.deferred_readiness(sink):
+        assert seam.active_sink() is sink
+        with seam.deferred_readiness(None):  # inline-dispatch escape
+            assert seam.active_sink() is None
+        assert seam.active_sink() is sink
+    assert seam.active_sink() is None
+
+
+def test_drain_sink_recycles_buffers_only_on_clean_drain():
+    from llm_weighted_consensus_tpu.models import dispatch_seam as seam
+
+    released = []
+    sink = seam.DispatchSink()
+    sink.staged.append("buf")
+    sink.add(
+        seam.PendingDispatch("x", 0.0, None, wait=lambda out: None, timed=False)
+    )
+    seam.drain_sink(sink, release=released.append)
+    assert released == ["buf"] and sink.staged == []
+
+    sink = seam.DispatchSink()
+    sink.staged.append("buf2")
+
+    def boom(out):
+        raise RuntimeError("device fault")
+
+    sink.add(seam.PendingDispatch("x", 0.0, None, wait=boom))
+    with pytest.raises(RuntimeError, match="device fault"):
+        seam.drain_sink(sink, release=released.append)
+    # a faulted drain drops its buffers for the GC — an async device_put
+    # may still be reading them, so recycling would hand out torn memory
+    assert released == ["buf"]
+
+
+class _FakeDeviceArray:
+    """A 'device' output handle that becomes ready ``device_sec`` after
+    its dispatch: any host materialization (or the seam waiter) blocks
+    until then, like a real PJRT buffer."""
+
+    def __init__(self, value, ready_at):
+        self._value = value
+        self.ready_at = ready_at
+
+    def block(self):
+        now = time.perf_counter()
+        if now < self.ready_at:
+            time.sleep(self.ready_at - now)
+
+    def __array__(self, dtype=None):
+        self.block()
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _fake_wait(out):
+    out.block()
+
+
+class _SlowDeviceEmbedder:
+    """Batcher-facing embedder whose device takes ``device_sec`` per
+    dispatch, mirroring ``TpuEmbedder._timed_dispatch``'s seam contract:
+    under a deferred-readiness sink the call returns at enqueue; direct
+    callers pay the inline timing bracket."""
+
+    max_tokens = 32
+
+    def __init__(self, device_sec, device_timing=True):
+        self.device_sec = device_sec
+        self.device_timing = device_timing
+
+    def tokenize(self, texts, max_tokens=None):
+        n = max(1, len(texts))
+        return (
+            np.ones((n, 8), np.int32),
+            np.ones((n, 8), np.int32),
+        )
+
+    def embed_tokens(self, ids, mask):
+        from llm_weighted_consensus_tpu.models import dispatch_seam as seam
+        from llm_weighted_consensus_tpu.obs import phases as _ph
+
+        t0 = time.perf_counter()
+        out = _FakeDeviceArray(
+            np.zeros((ids.shape[0], 4), np.float32),
+            t0 + self.device_sec,
+        )
+        label = f"fake(b={ids.shape[0]})"
+        sink = seam.active_sink()
+        if sink is not None:
+            sink.add(
+                seam.PendingDispatch(
+                    label, t0, out, wait=_fake_wait,
+                    timed=self.device_timing,
+                )
+            )
+            return out
+        if self.device_timing:
+            _fake_wait(out)
+            t1 = time.perf_counter()
+            _ph.observe_device(label, (t1 - t0) * 1e3)
+            _ph.observe_device_interval(t0, t1)
+        return out
+
+
+def test_pipelined_dispatches_overlap_with_device_timing_on():
+    """The ISSUE 13 acceptance drill: two pipelined groups against a
+    slow fake device, METRICS_DEVICE_TIMING semantics ON — their device
+    intervals must genuinely overlap and the pair must finish in well
+    under 2x one group's device time.  On main the blocking bracket
+    held the dispatch thread for the full device time, serializing the
+    pipeline (~2x)."""
+    from llm_weighted_consensus_tpu.obs import phases as ph
+    from llm_weighted_consensus_tpu.serve.batcher import DeviceBatcher
+
+    obs.reset_phases()
+    T = 0.2
+    fake = _SlowDeviceEmbedder(T, device_timing=True)
+    batcher = DeviceBatcher(fake, None, window_ms=0.0, pipeline_depth=2)
+
+    async def run():
+        t0 = time.perf_counter()
+        # different max_tokens caps -> different keys -> two groups
+        await asyncio.gather(
+            batcher.embed(["a"], 16), batcher.embed(["b"], 32)
+        )
+        return time.perf_counter() - t0
+
+    wall = go(run())
+    batcher.close()
+    intervals = ph.aggregator().device_intervals()
+    assert len(intervals) == 2
+    # the second dispatch enqueued before the first became ready
+    assert max(s for s, _ in intervals) < min(e for _, e in intervals)
+    assert wall < 1.5 * T, wall
+    # device time still recorded per (bucket) label, one per group
+    dev = ph.aggregator().device_snapshot()
+    assert dev["fake(b=1)"]["count"] == 2
+    assert ph.phases_snapshot()["overlap"] >= 0.8
+    obs.reset_phases()
+
+
+def test_waiter_and_bracket_device_times_agree():
+    """Satellite (b) parity: the deferred waiter path and the inline
+    bracket must report the same device time for the same work."""
+    from llm_weighted_consensus_tpu.models import dispatch_seam as seam
+    from llm_weighted_consensus_tpu.obs import phases as ph
+
+    obs.reset_phases()
+    T = 0.15
+    fake = _SlowDeviceEmbedder(T, device_timing=True)
+    # bracket mode: direct call, no sink active
+    fake.embed_tokens(*fake.tokenize(["a"]))
+    # deferred mode: enqueue under a sink, then drain like the waiter
+    sink = seam.DispatchSink()
+    with seam.deferred_readiness(sink):
+        fake.embed_tokens(*fake.tokenize(["b"]))
+    assert not _already_ready(sink)  # enqueue returned before readiness
+    seam.drain_sink(
+        sink,
+        observe_device=ph.observe_device,
+        observe_interval=ph.observe_device_interval,
+    )
+    row = ph.aggregator().device_snapshot()["fake(b=1)"]
+    assert row["count"] == 2
+    # both measurements bracket the same T-second device run
+    assert row["sum_ms"] / 2 == pytest.approx(T * 1e3, rel=0.5)
+    obs.reset_phases()
+
+
+def _already_ready(sink):
+    """True if the sink's pending output already had to materialize —
+    i.e. the dispatch thread blocked instead of deferring."""
+    return any(
+        time.perf_counter() >= rec.out.ready_at for rec in sink.pending
+    )
+
+
+def test_real_embedder_waiter_matches_bracket_labels():
+    """Smoke the seam against the real TpuEmbedder on CPU: the deferred
+    path must record the SAME bucket label as the inline bracket, with a
+    positive device time."""
+    from llm_weighted_consensus_tpu.models import dispatch_seam as seam
+    from llm_weighted_consensus_tpu.models.configs import TEST_TINY
+    from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+    from llm_weighted_consensus_tpu.obs import phases as ph
+
+    obs.reset_phases()
+    emb = TpuEmbedder("test-tiny", config=TEST_TINY, max_tokens=32)
+    emb.device_timing = True
+    ids, mask = emb.tokenize(["parity probe"])
+    emb.embed_tokens(ids, mask)  # bracket
+    bracket = set(ph.aggregator().device_snapshot())
+    obs.reset_phases()
+    sink = seam.DispatchSink()
+    with seam.deferred_readiness(sink):
+        out = emb.embed_tokens(ids, mask)
+    seam.drain_sink(
+        sink,
+        observe_device=ph.observe_device,
+        observe_interval=ph.observe_device_interval,
+    )
+    deferred = ph.aggregator().device_snapshot()
+    assert set(deferred) == bracket  # same (mesh-shape, bucket) labels
+    assert all(row["sum_ms"] > 0 for row in deferred.values())
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(emb.embed_tokens(ids, mask)),
+        rtol=1e-5, atol=1e-6,
+    )
+    obs.reset_phases()
 
 
 # -- served request: phase sum within 10% of e2e ------------------------------

@@ -12,6 +12,18 @@ Timing uses k-rep fori_loop differencing (median of trials) so the
 ~100 ms tunnel RTT and its jitter cancel out.  The headline decision is
 made on the IN-CONTEXT numbers from bench_fwd.py, not these — see the
 table in ops/attention.py.
+
+``--long-seq`` switches to the ring-vs-dense crossover scenario
+(PR 14 long-context serving): one attention op per sequence length,
+dense full-softmax vs ``parallel.ring.ring_attention`` sharded over an
+``sp`` mesh axis, reporting p50 per-call ms AND the compiled
+executable's per-device memory (argument+output+temp bytes from XLA
+``memory_analysis`` — the O(s^2) score materialization is the term the
+ring divides by sp^2).  The committed record is ``BENCH_attn.json``;
+the crossover sequence length is where the ring first wins on p50
+while its per-device peak stays flat.  Needs ``--sp`` devices: on CPU
+the bench respawns itself under ``--xla_force_host_platform_device_
+count`` (same recipe as the mesh audit).
 """
 
 from __future__ import annotations
@@ -71,6 +83,90 @@ def timed_ms(fn, params, reps_hi=201, trials=3):
     return samples[len(samples) // 2]
 
 
+def ring_vs_dense_crossover(seqs, sp, b, nh, hd, reps_hi=5, trials=3):
+    """One attention op per sequence length, dense vs ring-over-sp:
+    p50 per-call ms (k-rep differencing, fewer reps — long sequences
+    are slow everywhere) and per-device compiled memory.  Returns the
+    per-seq table plus the first sequence length where ring wins."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from llm_weighted_consensus_tpu.parallel.compat import shard_map
+    from llm_weighted_consensus_tpu.parallel.ring import ring_attention
+
+    mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
+    qkv_spec = P(None, "sp", None, None)
+    bias_spec = P(None, "sp")
+    ring_fn = jax.jit(
+        shard_map(
+            lambda q, k, v, bias, scale: ring_attention(
+                q, k, v, bias, scale, "sp"
+            ),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, bias_spec, P()),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+    )
+
+    def compiled_bytes(fn, *xs):
+        mem = jax.jit(fn).lower(*xs).compile().memory_analysis()
+        return {
+            "peak_bytes": int(
+                mem.argument_size_in_bytes
+                + mem.output_size_in_bytes
+                + mem.temp_size_in_bytes
+            ),
+            "temp_bytes": int(mem.temp_size_in_bytes),
+        }
+
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    rng = np.random.default_rng(0)
+    scale = 1.0 / float(hd) ** 0.5
+    rows = {}
+    crossover = None
+    for s in seqs:
+        if s % sp:
+            continue
+        shape = (b, s, nh, hd)
+        q = jnp.asarray(rng.standard_normal(shape), dtype)
+        k = jnp.asarray(rng.standard_normal(shape), dtype)
+        v = jnp.asarray(rng.standard_normal(shape), dtype)
+        bias = jnp.zeros((b, s), jnp.float32)
+
+        dense = compiled_bytes(
+            lambda q, k, v: einsum_attention(q, k, v, bias, scale), q, k, v
+        )
+        dense["p50_ms"] = timed_ms(
+            lambda q, k, v: einsum_attention(q, k, v, bias, scale),
+            (q, k, v), reps_hi=reps_hi, trials=trials,
+        )
+
+        sharding = NamedSharding(mesh, qkv_spec)
+        qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+        bs = jax.device_put(bias, NamedSharding(mesh, bias_spec))
+        scale_arr = jnp.float32(scale)
+        ref = np.asarray(
+            einsum_attention(q, k, v, bias, scale), np.float32
+        )
+        out = np.asarray(ring_fn(qs, ks, vs, bs, scale_arr), np.float32)
+        np.testing.assert_allclose(out, ref, atol=3e-2, rtol=3e-2)
+        # memory_analysis on the sharded executable is PER-DEVICE —
+        # exactly the "does the score tile fit one chip" question
+        ring = compiled_bytes(
+            lambda q, k, v, t: ring_fn(q, k, v, bs, t),
+            qs, ks, vs, scale_arr,
+        )
+        ring["p50_ms"] = timed_ms(
+            lambda q, k, v, t: ring_fn(q, k, v, bs, t),
+            (qs, ks, vs, scale_arr), reps_hi=reps_hi, trials=trials,
+        )
+        rows[f"s={s}"] = {"dense": dense, f"ring_sp{sp}": ring}
+        if crossover is None and ring["p50_ms"] < dense["p50_ms"]:
+            crossover = s
+        print(json.dumps({f"s={s}": rows[f"s={s}"]}), flush=True)
+    return rows, crossover
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--b", type=int, default=64)
@@ -78,13 +174,59 @@ def main():
     p.add_argument("--hd", type=int, default=64)
     p.add_argument("--seqs", default="128,256,512")
     p.add_argument("--ks", default="8,16,32")
-    p.add_argument("--probe-timeout", type=float, default=240.0)
+    # probe covers backend init + one real block_until_ready dispatch
+    # (bench.probe_backend), so a healthy backend answers in seconds and
+    # a wedged tunnel records tpu-unavailable in 45 s, not 240+600 s
+    p.add_argument("--probe-timeout", type=float, default=45.0)
+    p.add_argument(
+        "--long-seq",
+        action="store_true",
+        help="ring-vs-dense long-context crossover instead of the "
+        "tiling sweep: p50 + per-device compiled memory per sequence "
+        "length (--long-seqs), ring sharded over --sp devices",
+    )
+    p.add_argument("--long-seqs", default="256,512,1024,2048")
+    p.add_argument("--sp", type=int, default=4)
+    p.add_argument("--long-b", type=int, default=1)
+    p.add_argument("--long-nh", type=int, default=4)
     args = p.parse_args()
     # wedge-proofing: shared bounded-probe preamble (bench.probe_or_exit)
     # AFTER argparse so --help stays instant
     from bench import probe_or_exit
 
     probe_or_exit(args.probe_timeout)
+
+    if args.long_seq and jax.device_count() < args.sp:
+        # the ring needs --sp devices; a CPU backend exposes one by
+        # default, so respawn under the forced-host-device-count env
+        # (the parent backend is already initialized and cannot grow)
+        import os
+        import subprocess
+
+        from llm_weighted_consensus_tpu.parallel.dist import force_cpu_env
+
+        env = force_cpu_env(dict(os.environ), n_devices=args.sp)
+        return subprocess.run(
+            [sys.executable, __file__] + sys.argv[1:], env=env
+        ).returncode
+
+    if args.long_seq:
+        seqs = [int(x) for x in args.long_seqs.split(",")]
+        rows, crossover = ring_vs_dense_crossover(
+            seqs, args.sp, args.long_b, args.long_nh, args.hd
+        )
+        print(json.dumps({
+            "metric": "ring-vs-dense attention crossover "
+            "(p50 ms + per-device peak bytes per seq length)",
+            "backend": jax.default_backend(),
+            "sp": args.sp,
+            "b": args.long_b,
+            "nh": args.long_nh,
+            "hd": args.hd,
+            "crossover_seq": crossover,
+            "results": rows,
+        }))
+        return
 
     from llm_weighted_consensus_tpu.ops.attention import fused_attention_tiled
 

@@ -33,6 +33,16 @@ packed buckets (``aot_warmup(..., packed_buckets=...)``), asserts their
 keys landed (JXA004), and drives packed traffic through them asserting
 zero new ``embed_packed`` jit specializations (JXA005).
 
+The quantized-path rules extend to the packed-int4 W4A8 mode: the
+serving entry points are re-traced with ``int4-pallas`` pinned
+(JXA002's dequant predicate widens to uint8 nibble storage -> float,
+the lost-int4-kernel regression), and a second AOT guard warms and
+drives an ``int4-pallas`` embedder through the shared bucket-key
+namespace (JXA004/005).  The sequence-parallel ring entry points
+(``parallel.ring._ring_embed_jit`` / ``_ring_embed_and_vote``) are
+traced under a live ``sp`` mesh for JXA001/2/3 whenever the backend has
+>= 2 devices (tier-1 always does).
+
 Env knobs (all optional): ``ANALYSIS_JAXPR_MODEL`` (preset, default
 ``test-tiny``), ``ANALYSIS_JAXPR_SPECS`` (comma list of ``NxS``,
 default ``4x16``), ``ANALYSIS_JAXPR_R_BUCKETS`` (comma list, default
@@ -124,11 +134,14 @@ def walk_jaxpr(jaxpr, visit) -> None:
 
 
 def audit_closed_jaxpr(
-    closed, label: str, *, expect_pallas: bool = False
+    closed, label: str, *, expect_pallas: bool = False, int4: bool = False
 ) -> List[Finding]:
     """The structural checks over one traced function (a
     ``jax.make_jaxpr`` result).  ``expect_pallas`` additionally asserts
-    the fused int8 kernel is still present (JXA002's other half)."""
+    the fused quantized kernel is still present (JXA002's other half);
+    ``int4`` widens the dequant predicate to the packed W4A8 layout
+    (uint8 nibble storage -> float is the lost-int4-kernel regression,
+    exactly as int8 -> float is the lost-int8-kernel one)."""
     import jax.numpy as jnp
 
     findings: List[Finding] = []
@@ -170,6 +183,22 @@ def audit_closed_jaxpr(
                         ),
                     )
                 )
+            if int4 and src.dtype == jnp.uint8 and jnp.issubdtype(
+                dst.dtype, jnp.floating
+            ):
+                findings.append(
+                    Finding(
+                        rule="JXA002",
+                        path=f"jaxpr:{label}",
+                        line=0,
+                        message=(
+                            "`convert_element_type` uint8->"
+                            f"{dst.dtype.name}: the packed int4 nibbles "
+                            "were dequantized to float outside the "
+                            "fused W4A8 kernel"
+                        ),
+                    )
+                )
         for var in eqn.outvars:
             aval = getattr(var, "aval", None)
             dtype = getattr(aval, "dtype", None)
@@ -189,14 +218,16 @@ def audit_closed_jaxpr(
 
     walk_jaxpr(closed.jaxpr, visit)
     if expect_pallas and pallas_calls == 0:
+        kernel = "W4A8" if int4 else "W8A8"
         findings.append(
             Finding(
                 rule="JXA002",
                 path=f"jaxpr:{label}",
                 line=0,
                 message=(
-                    "int8 path traced with ZERO pallas_call equations; "
-                    "the fused W8A8 kernel fell out of the forward"
+                    f"{'int4' if int4 else 'int8'} path traced with ZERO "
+                    f"pallas_call equations; the fused {kernel} kernel "
+                    "fell out of the forward"
                 ),
             )
         )
@@ -204,7 +235,12 @@ def audit_closed_jaxpr(
 
 
 def audit_traced(
-    fn, example_args: Sequence, label: str, *, expect_pallas: bool = False
+    fn,
+    example_args: Sequence,
+    label: str,
+    *,
+    expect_pallas: bool = False,
+    int4: bool = False,
 ) -> List[Finding]:
     """Trace ``fn(*example_args)`` and run the structural checks.
 
@@ -233,7 +269,9 @@ def audit_traced(
                 ),
             )
         ]
-    return audit_closed_jaxpr(closed, label, expect_pallas=expect_pallas)
+    return audit_closed_jaxpr(
+        closed, label, expect_pallas=expect_pallas, int4=int4
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -344,6 +382,120 @@ def _structure_findings(
                 expect_pallas=True,
             )
         )
+    findings += _int4_structure_findings(model, specs)
+    findings += _ring_structure_findings(model, specs)
+    return findings
+
+
+def _int4_structure_findings(model: str, specs) -> List[Finding]:
+    """The W4A8 twin of the int8 structure audit: trace the serving
+    entry points with ``int4-pallas`` pinned and assert the fused packed
+    kernel is present (and that no uint8->float dequant crept in — the
+    lost-int4-kernel regression, JXA002)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.embedder import (
+        TpuEmbedder,
+        _bucket,
+        _embed_and_vote,
+        _seq_bucket,
+    )
+    from ..models import bert
+
+    embedder = TpuEmbedder(
+        model, max_tokens=64, seed=0, quantize="int4-pallas"
+    )
+    sds = jax.ShapeDtypeStruct
+    temp = sds((), jnp.float32)
+    findings: List[Finding] = []
+    for n, s in specs:
+        s = _seq_bucket(s, embedder.max_tokens)
+        ids = sds((n, s), jnp.int32)
+        findings.extend(
+            audit_traced(
+                lambda p, i, m, t, _n=n: _embed_and_vote(
+                    p, i, m, t, _n, embedder.config, embedder.pooling, True
+                ),
+                (embedder.params, ids, ids, temp),
+                f"int4:vote1(n={n},s={s})",
+                expect_pallas=True,
+                int4=True,
+            )
+        )
+        pad_b = _bucket(n, embedder.MAX_DEVICE_BATCH)
+        bids = sds((pad_b, s), jnp.int32)
+        findings.extend(
+            audit_traced(
+                lambda p, i, m: bert.embed(
+                    p, i, m, embedder.config,
+                    pooling=embedder.pooling, normalize=True,
+                ),
+                (embedder.params, bids, bids),
+                f"int4:embed(b={pad_b},s={s})",
+                expect_pallas=True,
+                int4=True,
+            )
+        )
+    return findings
+
+
+def _ring_structure_findings(model: str, specs) -> List[Finding]:
+    """JXA001/2/3 over the sequence-parallel (ring attention) serving
+    entry points — the exact jitted functions the sp-mesh batcher
+    dispatches (``parallel.ring._ring_embed_jit`` /
+    ``_ring_embed_and_vote``).  The ring shard_map needs a live mesh
+    with an ``sp`` axis, so this leg runs only when the backend has at
+    least two devices (tier-1's 8 virtual CPUs always qualify; a bare
+    single-device CLI run skips it — the mesh audit still covers the
+    compiled ring executables there)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.device_count() < 2:
+        return []
+
+    from ..models.embedder import TpuEmbedder, _seq_bucket
+    from ..parallel.mesh import make_mesh
+    from ..parallel.ring import _ring_embed_and_vote, _ring_embed_jit
+
+    sp = 2
+    mesh = make_mesh(dp=1, tp=1, sp=sp)
+    embedder = TpuEmbedder(model, max_tokens=64, seed=0, quantize="int8-pallas")
+    ring_config = dataclasses.replace(
+        embedder.config, attention_impl="ring", ring_axis="sp"
+    )
+    sds = jax.ShapeDtypeStruct
+    temp = sds((), jnp.float32)
+    findings: List[Finding] = []
+    for n, s in specs:
+        s = _seq_bucket(s, embedder.max_tokens)
+        s = min(s + (-s) % sp, embedder.max_tokens)
+        ids = sds((n, s), jnp.int32)
+        findings.extend(
+            audit_traced(
+                lambda p, i, m: _ring_embed_jit(
+                    p, i, m, ring_config, mesh, "sp", "dp",
+                    embedder.pooling, True,
+                ),
+                (embedder.params, ids, ids),
+                f"ring(b={n},s={s})",
+                expect_pallas=True,
+            )
+        )
+        findings.extend(
+            audit_traced(
+                lambda p, i, m, t, _n=n: _ring_embed_and_vote(
+                    p, i, m, t, _n, ring_config, mesh, "sp", "dp",
+                    embedder.pooling,
+                ),
+                (embedder.params, ids, ids, temp),
+                f"ring_vote(n={n},s={s})",
+                expect_pallas=True,
+            )
+        )
     return findings
 
 
@@ -448,6 +600,72 @@ def _aot_findings(model: str, specs, r_buckets, packed_buckets) -> List[Finding]
                         f"`{entry}` grew {grew} jit specialization(s) "
                         "under post-warmup traffic at warmed buckets — "
                         "the AOT table is not being consulted"
+                    ),
+                )
+            )
+    findings += _int4_aot_findings(model, specs)
+    return findings
+
+
+def _int4_aot_findings(model: str, specs) -> List[Finding]:
+    """JXA004/JXA005 for the ``int4-pallas`` serving mode: the packed
+    W4A8 path shares the AOT key namespace with every other quantize
+    mode, so warmup must land the same bucket keys and post-warmup
+    traffic must ride them with zero jit growth.  The fused kernel runs
+    in interpret mode on CPU, so this drives real dispatches in tier-1."""
+    import numpy as np
+
+    from ..models.embedder import TpuEmbedder, _bucket, _seq_bucket
+
+    embedder = TpuEmbedder(
+        model, max_tokens=64, seed=0, quantize="int4-pallas"
+    )
+    findings: List[Finding] = []
+    embedder.aot_warmup([(n, s) for n, s in specs])
+    rng = np.random.default_rng(11)
+    vocab = embedder.config.vocab_size
+    for n, s in specs:
+        s = _seq_bucket(s, embedder.max_tokens)
+        expected = [
+            ("vote1", n, s, True),
+            ("vote1", n, s, False),
+            ("embed", _bucket(n, embedder.MAX_DEVICE_BATCH), s),
+        ]
+        for key in expected:
+            if key not in embedder._aot:
+                findings.append(
+                    Finding(
+                        rule="JXA004",
+                        path=f"jaxpr:aot({model},int4)",
+                        line=0,
+                        message=(
+                            f"int4-pallas serving bucket {key} missing "
+                            "from the AOT executable table after warmup "
+                            "— this shape will lazily specialize under "
+                            "live traffic"
+                        ),
+                    )
+                )
+    stats0 = embedder.jit_stats()["specializations"]
+    for n, s in specs:
+        s = _seq_bucket(s, embedder.max_tokens)
+        ids = rng.integers(3, vocab, (n, s)).astype(np.int32)
+        mask = np.ones((n, s), np.int32)
+        embedder.consensus_confidence_tokens(ids, mask)
+        embedder.embed_tokens(ids, mask)
+    stats1 = embedder.jit_stats()["specializations"]
+    for entry, count in stats1.items():
+        grew = count - stats0.get(entry, 0)
+        if grew > 0:
+            findings.append(
+                Finding(
+                    rule="JXA005",
+                    path=f"jaxpr:aot({model},int4)",
+                    line=0,
+                    message=(
+                        f"`{entry}` grew {grew} jit specialization(s) "
+                        "under post-warmup int4-pallas traffic — the "
+                        "AOT table is not being consulted"
                     ),
                 )
             )

@@ -46,6 +46,10 @@ TPU chip is rented:
   a mid-incident downsize compiles under fire), and driving the public
   dispatch on each downsized rung must pass the JXA011 parity gate
   against the single-device reference with zero specialization growth.
+  The ladder audit runs twice: once on the dense dp×tp mesh and once on
+  the sp-bearing ring mesh (dp halves, tp AND sp preserved per rung,
+  ring buckets pre-warmed under each rung's ``("mesh", dp, tp, sp)``
+  namespace).
 * **JXA013 roofline coverage** — every audited bucket must have a
   live row in ``analysis/roofline.json`` (flops / bytes-accessed plus
   per-chip backend peaks) so the serving gauge can report speed-of-light
@@ -58,12 +62,23 @@ runs in-process; the bare CLI process has one device, so
 ``run_mesh_audit`` respawns itself as a subprocess with
 ``force_cpu_env`` — the same recipe the DCN smoke uses.
 
+The long-context ring (sequence-parallel) serving path is audited on
+an sp-bearing sibling mesh: the sp axis folds out of dp
+(``dp//sp × tp × sp``, default 2×2×2) so the device budget stays
+``dp*tp``, and the warmed ``("ring", B, S)`` / ``("ring_vote", N, S)``
+executables get the same JXA008–011 treatment — their figures land in
+``budgets.json`` / ``roofline.json`` next to the dense buckets, and
+JXA011 parity runs ring-vs-dense against the single-device reference.
+
 Env knobs (all optional): ``ANALYSIS_MESH_MODEL`` (embedder preset,
 default ``test-tiny``), ``ANALYSIS_MESH_DP`` / ``ANALYSIS_MESH_TP``
-(mesh shape, default 4×2), ``ANALYSIS_MESH_SPECS`` (``NxS`` list,
-default ``8x16``), ``ANALYSIS_MESH_R_BUCKETS`` (default ``2``),
-``ANALYSIS_MESH_PACKED_BUCKETS`` (``BxLxK`` list, default ``8x64x8``),
-``ANALYSIS_BUDGETS`` (budgets file override), ``ANALYSIS_ROOFLINE``
+(mesh shape, default 4×2), ``ANALYSIS_MESH_SP`` (ring mesh sp axis,
+default 2; 1 disables the ring audit), ``ANALYSIS_MESH_SPECS``
+(``NxS`` list, default ``8x16``), ``ANALYSIS_MESH_R_BUCKETS`` (default
+``2``), ``ANALYSIS_MESH_PACKED_BUCKETS`` (``BxLxK`` list, default
+``8x64x8``), ``ANALYSIS_MESH_RING_BUCKETS`` (``NxS`` list, default
+``2x64``; empty disables the ring audit), ``ANALYSIS_BUDGETS``
+(budgets file override), ``ANALYSIS_ROOFLINE``
 (roofline file override), ``ANALYSIS_SKIP_MESH=1``
 to skip (honored by the CLI and scripts/t1.sh; tier-1 does not set it).
 
@@ -105,6 +120,12 @@ _DEFAULT_DP, _DEFAULT_TP = 4, 2
 _DEFAULT_SPECS = ((8, 16),)
 _DEFAULT_R_BUCKETS = (2,)
 _DEFAULT_PACKED_BUCKETS = ((8, 64, 8),)
+# the long-context ring audit folds the sp axis out of dp (dp//sp x tp
+# x sp) so the device budget stays dp*tp; sp=2 over the default 4x2
+# mesh gives the 2x2x2 sp-bearing shape serve/__main__.py would build
+# from MESH_SHAPE=2x2x2
+_DEFAULT_SP = 2
+_DEFAULT_RING_BUCKETS = ((2, 64),)
 
 # shape-only presets for the coverage/replication checks: the BIG trees,
 # because that is where an accidentally replicated table costs real HBM
@@ -163,6 +184,29 @@ def _env_packed_buckets() -> Tuple[Tuple[int, int, int], ...]:
     )
 
 
+def _env_sp() -> int:
+    return _env_int("ANALYSIS_MESH_SP", _DEFAULT_SP)
+
+
+def _env_ring_buckets() -> Tuple[Tuple[int, int], ...]:
+    """``NxS`` long-context ring buckets; an explicitly empty
+    ``ANALYSIS_MESH_RING_BUCKETS`` disables the ring audit."""
+    raw = os.environ.get("ANALYSIS_MESH_RING_BUCKETS")
+    if raw is None:
+        return _DEFAULT_RING_BUCKETS
+    return tuple(
+        tuple(int(x) for x in part.strip().lower().split("x"))
+        for part in raw.split(",")
+        if part.strip()
+    )
+
+
+def _ring_enabled() -> bool:
+    dp, tp = _env_mesh()
+    sp = _env_sp()
+    return bool(_env_ring_buckets()) and sp > 1 and dp % sp == 0
+
+
 def _budgets_path() -> Path:
     raw = os.environ.get("ANALYSIS_BUDGETS", "")
     return Path(raw) if raw.strip() else default_budgets_path()
@@ -184,6 +228,10 @@ def _scope() -> dict:
         "r_buckets": list(_env_r_buckets()),
         "packed_buckets": [
             "x".join(map(str, b)) for b in _env_packed_buckets()
+        ],
+        "sp": _env_sp(),
+        "ring_buckets": [
+            "x".join(map(str, b)) for b in _env_ring_buckets()
         ],
     }
 
@@ -409,6 +457,26 @@ def audit_hlo_collectives(
 # ---------------------------------------------------------------------------
 
 
+def _exe_figures(exe) -> Dict[str, float]:
+    """The budget/roofline figures of one compiled executable: static
+    HBM footprint (``memory_analysis``) plus flops / bytes-accessed
+    (``cost_analysis``) — the shared measurement for every audited
+    bucket (padded, packed, ring, reward)."""
+    mem = exe.memory_analysis()
+    figures = {
+        "hbm_bytes": float(
+            mem.argument_size_in_bytes
+            + mem.output_size_in_bytes
+            + mem.temp_size_in_bytes
+        ),
+    }
+    cost = exe.cost_analysis()
+    cost0 = cost[0] if isinstance(cost, (list, tuple)) else cost
+    figures["flops"] = float(cost0.get("flops", 0.0))
+    figures["bytes_accessed"] = float(cost0.get("bytes accessed", 0.0))
+    return figures
+
+
 def _packed_inputs(rng, vocab: int, b: int, l: int, k: int):
     import numpy as np
 
@@ -477,19 +545,7 @@ def audit_serving_executables(
             )
             return
         findings.extend(audit_hlo_collectives(exe.as_text(), label))
-        mem = exe.memory_analysis()
-        figures = {
-            "hbm_bytes": float(
-                mem.argument_size_in_bytes
-                + mem.output_size_in_bytes
-                + mem.temp_size_in_bytes
-            ),
-        }
-        cost = exe.cost_analysis()
-        cost0 = cost[0] if isinstance(cost, (list, tuple)) else cost
-        figures["flops"] = float(cost0.get("flops", 0.0))
-        figures["bytes_accessed"] = float(cost0.get("bytes accessed", 0.0))
-        measured[label] = figures
+        measured[label] = _exe_figures(exe)
 
     def check(label, got, want):
         got, want = np.asarray(got), np.asarray(want)
@@ -755,6 +811,260 @@ def _audit_fault_ladder(
     return findings
 
 
+def _ring_bucket_keys(embedder, ring_buckets):
+    """The (label, AOT sub-key) pairs ``aot_warmup(...,
+    ring_buckets=...)`` lands for a warmed sp-mesh embedder — snapped
+    through the same sequence-bucket + sp-multiple rounding the warmup
+    and the dispatch both apply, so the audit checks the keys that
+    actually serve."""
+    from ..models.embedder import _bucket, _seq_bucket
+
+    sp = embedder.mesh_sp
+    bm = embedder.batch_multiple
+    out = []
+    for n, s in ring_buckets:
+        s = _seq_bucket(s, embedder.ring_max_tokens)
+        s = min(s + (-s) % sp, embedder.ring_max_tokens)
+        out.append((f"ring_vote(n={n},s={s})", ("ring_vote", n, s)))
+        pad_b = _bucket(n, embedder.MAX_DEVICE_BATCH)
+        pad_b += (-pad_b) % bm
+        out.append((f"ring(b={pad_b},s={s})", ("ring", pad_b, s)))
+    return out
+
+
+def _measure_ring_buckets(
+    model: str, dp: int, tp: int, sp: int, ring_buckets
+) -> Tuple[List[Finding], Dict[str, Dict[str, float]]]:
+    """JXA008–011 over the long-context ring (sequence-parallel)
+    buckets: build the sp-bearing mesh embedder exactly as
+    serve/__main__.py does from ``MESH_SHAPE=dpxTPxSP`` +
+    ``LONG_CONTEXT_WARMUP`` (the sp axis folds out of dp so the device
+    budget stays ``dp*tp``), then audit the warmed ring executables —
+    collective plan and resource figures straight off the AOT table,
+    and ring-vs-dense parity through the PUBLIC ring dispatch against a
+    same-seed single-device reference (the ring rotation must be a
+    layout change, not a math change), bracketed by the usual
+    zero-specialization guard."""
+    import numpy as np
+
+    from ..models.embedder import TpuEmbedder
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import shard_embedder_mesh
+
+    findings: List[Finding] = []
+    measured: Dict[str, Dict[str, float]] = {}
+    mesh = make_mesh(dp=dp // sp, tp=tp, sp=sp)
+    ref = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
+    embedder = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
+    shard_embedder_mesh(embedder, mesh)
+    embedder.aot_warmup([], ring_buckets=list(ring_buckets))
+
+    rng = np.random.default_rng(3)
+    vocab = embedder.config.vocab_size
+    temp = 1.0
+    atol = 1e-4
+
+    def account(label, key):
+        exe = embedder._aot.get(embedder._ring_aot_key(key))
+        if exe is None:
+            findings.append(
+                Finding(
+                    rule="JXA008",
+                    path=f"mesh:{label}",
+                    line=0,
+                    message=(
+                        f"no AOT executable at ring bucket {key}: "
+                        "aot_warmup(ring_buckets=...) did not cover it, "
+                        "so long-context traffic at this bucket would "
+                        "lazily jit mid-request"
+                    ),
+                )
+            )
+            return
+        findings.extend(audit_hlo_collectives(exe.as_text(), label))
+        measured[label] = _exe_figures(exe)
+
+    # inputs + single-device DENSE reference outputs first (shared jit
+    # caches; the zero-growth bracket below must see ring traffic only)
+    cases = []
+    for label, key in _ring_bucket_keys(embedder, ring_buckets):
+        kind, s = key[0], key[-1]
+        if kind == "ring_vote":
+            n = key[1]
+            ids = rng.integers(3, vocab, (n, s)).astype(np.int32)
+            mask = np.ones((n, s), np.int32)
+            ref_out = np.asarray(
+                ref.consensus_confidence_tokens(ids, mask, temperature=temp)
+            )
+        else:
+            pad_b = key[1]
+            ids = rng.integers(3, vocab, (pad_b, s)).astype(np.int32)
+            mask = np.ones((pad_b, s), np.int32)
+            ref_out = np.asarray(ref.embed_tokens(ids, mask))
+        cases.append((kind, label, key, (ids, mask), ref_out))
+
+    before = embedder.jit_stats()["specializations"]
+    for kind, label, key, args, ref_out in cases:
+        account(label, key)
+        if kind == "ring_vote":
+            got = embedder.consensus_confidence_tokens_ring(
+                args[0], args[1], temperature=temp
+            )
+        else:
+            got = embedder.embed_tokens_ring(*args)
+        got = np.asarray(got)
+        if not np.allclose(got, ref_out, atol=atol, rtol=1e-4):
+            worst = float(np.max(np.abs(got - ref_out)))
+            findings.append(
+                Finding(
+                    rule="JXA011",
+                    path=f"mesh:{label}",
+                    line=0,
+                    message=(
+                        "ring dispatch diverges from the single-device "
+                        f"dense reference (max abs diff {worst:.2e} > "
+                        f"{atol}): the sequence rotation changed the "
+                        "math, not just the layout"
+                    ),
+                )
+            )
+    after = embedder.jit_stats()["specializations"]
+    grew = {
+        name: f"{before.get(name, 0)}->{count}"
+        for name, count in after.items()
+        if count > before.get(name, 0)
+    }
+    if grew:
+        findings.append(
+            Finding(
+                rule="JXA008",
+                path="mesh:ring-dispatch",
+                line=0,
+                message=(
+                    "ring dispatches bypassed the audited AOT "
+                    f"executables and lazily jitted instead ({grew}): "
+                    "the ring bucket figures above describe executables "
+                    "that served no traffic"
+                ),
+            )
+        )
+    return findings, measured
+
+
+def _audit_ring_fault_ladder(
+    model: str, dp: int, tp: int, sp: int, ring_buckets
+) -> List[Finding]:
+    """JXA012 on the sp-bearing mesh: walk the downsize ladder of a
+    ring-serving embedder (dp halves, tp AND sp preserved per rung) and
+    on every fallback rung assert the ring buckets were pre-warmed
+    under that rung's ``("mesh", dp, tp, sp)`` namespace and that the
+    public ring dispatch still matches the single-device dense
+    reference with zero jit growth — a downsize mid-incident must not
+    compile a ring executable or corrupt a long-context answer."""
+    import numpy as np
+
+    from ..models.embedder import TpuEmbedder
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import shard_embedder_mesh
+    from ..resilience import MeshFaultManager
+
+    findings: List[Finding] = []
+    rdp = dp // sp
+    if rdp < 2:
+        return findings  # no rung below the full shape to walk
+    ref = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
+    embedder = TpuEmbedder(model, max_tokens=64, seed=0, quantize="none")
+    shard_embedder_mesh(embedder, make_mesh(dp=rdp, tp=tp, sp=sp))
+    manager = MeshFaultManager(embedder, shape=(rdp, tp))
+    manager.warm_ladder([], ring_buckets=list(ring_buckets))
+
+    rng = np.random.default_rng(5)
+    vocab = embedder.config.vocab_size
+    atol = 1e-4
+    cases = []
+    for label, key in _ring_bucket_keys(embedder, ring_buckets):
+        if key[0] != "ring_vote":
+            continue
+        n, s = key[1], key[2]
+        ids = rng.integers(3, vocab, (n, s)).astype(np.int32)
+        mask = np.ones((n, s), np.int32)
+        ref_out = np.asarray(ref.consensus_confidence_tokens(ids, mask))
+        cases.append((n, s, ids, mask, ref_out))
+
+    for rung_dp, rung_tp in manager.build_ladder()[1:]:
+        label = f"ring-ladder:{rung_dp}x{rung_tp}x{sp}"
+        if not manager.downsize():
+            findings.append(
+                Finding(
+                    rule="JXA012",
+                    path=f"mesh:{label}",
+                    line=0,
+                    message=(
+                        "downsize() refused a declared ladder rung on "
+                        "the sp-bearing mesh: the ladder the manager "
+                        "walks is not the ladder it declared"
+                    ),
+                )
+            )
+            break
+        for _blabel, key in _ring_bucket_keys(embedder, ring_buckets):
+            if embedder._aot.get(embedder._ring_aot_key(key)) is None:
+                findings.append(
+                    Finding(
+                        rule="JXA012",
+                        path=f"mesh:{label}",
+                        line=0,
+                        message=(
+                            f"no AOT executable at fallback-rung ring "
+                            f"bucket {key}: warm_ladder did not cover "
+                            f"it, so a downsize to {rung_dp}x{rung_tp}"
+                            f"x{sp} would compile a long-context "
+                            "executable mid-incident"
+                        ),
+                    )
+                )
+        before = embedder.jit_stats()["specializations"]
+        for n, s, ids, mask, ref_out in cases:
+            got = np.asarray(
+                embedder.consensus_confidence_tokens_ring(ids, mask)
+            )
+            if not np.allclose(got, ref_out, atol=atol, rtol=1e-4):
+                worst = float(np.max(np.abs(got - ref_out)))
+                findings.append(
+                    Finding(
+                        rule="JXA012",
+                        path=f"mesh:{label}",
+                        line=0,
+                        message=(
+                            "degraded-rung ring dispatch diverges from "
+                            "the single-device dense reference (max abs "
+                            f"diff {worst:.2e} > {atol}): re-dispatched "
+                            "long-context answers after a real downsize "
+                            "would be wrong"
+                        ),
+                    )
+                )
+        after = embedder.jit_stats()["specializations"]
+        grew = {
+            name: f"{before.get(name, 0)}->{count}"
+            for name, count in after.items()
+            if count > before.get(name, 0)
+        }
+        if grew:
+            findings.append(
+                Finding(
+                    rule="JXA012",
+                    path=f"mesh:{label}",
+                    line=0,
+                    message=(
+                        "rung ring dispatches bypassed the warmed "
+                        f"executables and lazily jitted instead ({grew})"
+                    ),
+                )
+            )
+    return findings
+
+
 def _measure_reward_packed(
     mesh, packed_buckets
 ) -> Tuple[List[Finding], Dict[str, Dict[str, float]]]:
@@ -801,19 +1111,7 @@ def _measure_reward_packed(
         ]
         compiled = jitted.lower(rm_params_s, *args).compile()
         findings.extend(audit_hlo_collectives(compiled.as_text(), label))
-        mem = compiled.memory_analysis()
-        figures = {
-            "hbm_bytes": float(
-                mem.argument_size_in_bytes
-                + mem.output_size_in_bytes
-                + mem.temp_size_in_bytes
-            ),
-        }
-        cost = compiled.cost_analysis()
-        cost0 = cost[0] if isinstance(cost, (list, tuple)) else cost
-        figures["flops"] = float(cost0.get("flops", 0.0))
-        figures["bytes_accessed"] = float(cost0.get("bytes accessed", 0.0))
-        measured[label] = figures
+        measured[label] = _exe_figures(compiled)
         # JXA011: only the used slots are defined output (unused slots
         # carry garbage rewards by contract) — compare slots 0..1
         sharded_out = np.asarray(compiled(rm_params_s, *args))
@@ -921,6 +1219,19 @@ def _audit_in_process(
         _env_model(), dp, tp,
         _env_specs(), _env_r_buckets(), _env_packed_buckets(),
     )
+    # long-context ring buckets on the sp-bearing mesh: same JXA008–011
+    # treatment (figures land in budgets/roofline next to the dense
+    # buckets), plus the sp-preserving downsize ladder (JXA012)
+    if _ring_enabled():
+        sp = _env_sp()
+        ring_findings, ring_measured = _measure_ring_buckets(
+            _env_model(), dp, tp, sp, _env_ring_buckets()
+        )
+        findings += ring_findings
+        measured.update(ring_measured)
+        findings += _audit_ring_fault_ladder(
+            _env_model(), dp, tp, sp, _env_ring_buckets()
+        )
     if write_budgets:
         _write_budgets_file(budgets_path, measured, budgets)
     else:

@@ -14,8 +14,9 @@ speed-of-light (SoL) time on a given backend:
   ``cost_analysis``, the same figures the mesh audit measures for the
   budgets file.  Rows are committed mesh-shape-free: the runtime gauge
   scales peaks by the chip count parsed from the serving label's
-  ``@dp{dp}xtp{tp}`` suffix, so ONE committed row covers every
-  mesh-ladder rung (dp-halving keeps per-bucket totals, splits chips).
+  ``@dp{dp}xtp{tp}[xsp{sp}]`` suffix, so ONE committed row covers every
+  mesh-ladder rung (dp-halving keeps per-bucket totals, splits chips)
+  and every sequence-parallel ring bucket.
 
 ``sol_ms = max(flops / (peak_flops * chips),
                bytes_accessed / (peak_bw * chips)) * 1e3``
@@ -61,7 +62,9 @@ DEFAULT_PEAKS = {
     "cpu": {"flops_per_sec": 5.0e10, "hbm_bytes_per_sec": 2.0e10},
 }
 
-_MESH_SUFFIX = re.compile(r"^(?P<base>.+)@dp(?P<dp>\d+)xtp(?P<tp>\d+)$")
+_MESH_SUFFIX = re.compile(
+    r"^(?P<base>.+)@dp(?P<dp>\d+)xtp(?P<tp>\d+)(?:xsp(?P<sp>\d+))?$"
+)
 
 
 def default_roofline_path() -> Path:
@@ -78,12 +81,17 @@ def load_roofline(path: Optional[Path] = None) -> dict:
 def split_label(label: str) -> Tuple[str, int]:
     """Runtime device-timing label -> (committed row label, chip count).
 
-    ``vote1(n=8,s=16)@dp4xtp2`` -> (``vote1(n=8,s=16)``, 8); an
-    unsuffixed single-device label counts as one chip."""
+    ``vote1(n=8,s=16)@dp4xtp2`` -> (``vote1(n=8,s=16)``, 8); the
+    sequence-parallel suffix multiplies too (``ring(b=16,s=64)
+    @dp2xtp2xsp2`` -> 8 chips); an unsuffixed single-device label
+    counts as one chip."""
     m = _MESH_SUFFIX.match(label)
     if m is None:
         return label, 1
-    return m.group("base"), int(m.group("dp")) * int(m.group("tp"))
+    chips = int(m.group("dp")) * int(m.group("tp"))
+    if m.group("sp"):
+        chips *= int(m.group("sp"))
+    return m.group("base"), chips
 
 
 def sol_ms(figures: dict, peaks: dict, chips: int = 1) -> Optional[float]:

@@ -278,6 +278,14 @@ class TpuEmbedder:
         self.mesh_shape = None
         self.batch_sharding = None
         self.repl_sharding = None
+        # long-context ring dispatch (MESH_SHAPE=dp,tp,sp;
+        # parallel.shard_embedder_mesh sets these when the mesh carries
+        # an sp axis).  mesh_sp == 1 means no ring path: every dense
+        # dispatch above stays byte-identical to the 2-axis mesh.
+        self.mesh_sp = 1
+        self.ring_sharding = None
+        self.ring_max_tokens = None
+        self._ring_config = None
         # per-(mesh-shape, bucket) device timing at the dispatch seam
         # (obs/phases.py; METRICS_DEVICE_TIMING=0 clears it).  Direct
         # callers pay a block-until-ready bracket; under the batcher's
@@ -322,6 +330,26 @@ class TpuEmbedder:
             return ("mesh",) + tuple(self.mesh_shape) + key
         return key
 
+    def _ring_aot_key(self, key: tuple) -> tuple:
+        """AOT key for the ring (sequence-parallel) dispatch: namespaced
+        by the FULL (dp, tp, sp) shape, so ring executables — which bake
+        the (dp, sp) input sharding and the ring collectives — can never
+        collide with the dense ("mesh", dp, tp, ...) entries even when
+        the bucket tuple matches."""
+        return ("mesh",) + tuple(self.mesh_shape) + (self.mesh_sp,) + key
+
+    def ring_available(self) -> bool:
+        """Whether the long-context ring dispatch is wired: first-class
+        mesh mode on a mesh with an sp axis (shard_embedder_mesh set
+        ``mesh_sp``/``ring_sharding``/``_ring_config``).  The batcher
+        routes over-length requests here only when this holds; otherwise
+        they truncate at ``max_tokens`` exactly as before."""
+        return (
+            self.mesh_mode
+            and self.mesh_sp > 1
+            and self.embed_override is None
+        )
+
     def _stage_batch(self, *arrays):
         """Stage host int32 arrays for an AOT executable call: mesh mode
         device_puts with the baked batch sharding (rows split over dp);
@@ -356,6 +384,8 @@ class TpuEmbedder:
         if self.mesh_mode:
             dp, tp = self.mesh_shape
             label = f"{label}@dp{dp}xtp{tp}"
+            if self.mesh_sp > 1:
+                label = f"{label}xsp{self.mesh_sp}"
         if sink is not None:
             sink.add(
                 PendingDispatch(label, t0, out, timed=self.device_timing)
@@ -445,7 +475,11 @@ class TpuEmbedder:
         return self._aot.get(key)
 
     def aot_warmup(
-        self, specs: list, r_buckets: list = (), packed_buckets: list = ()
+        self,
+        specs: list,
+        r_buckets: list = (),
+        packed_buckets: list = (),
+        ring_buckets: list = (),
     ) -> list:
         """AOT-lower-and-compile (``.lower().compile()``) every serving
         bucket up front: for each (N, S) spec the single-request consensus
@@ -480,7 +514,10 @@ class TpuEmbedder:
                 "(serve/__main__.py)"
             )
         if self.mesh_mode:
-            return self._aot_warmup_mesh(specs, r_buckets, packed_buckets)
+            return self._aot_warmup_mesh(
+                specs, r_buckets, packed_buckets, ring_buckets
+            )
+        # ring buckets need an sp mesh; single-device warmup ignores them
         sds = jax.ShapeDtypeStruct
         temp_av = sds((), jnp.float32)
         timings = []
@@ -548,7 +585,11 @@ class TpuEmbedder:
         return timings
 
     def _aot_warmup_mesh(
-        self, specs: list, r_buckets: list = (), packed_buckets: list = ()
+        self,
+        specs: list,
+        r_buckets: list = (),
+        packed_buckets: list = (),
+        ring_buckets: list = (),
     ) -> list:
         """The mesh-mode half of ``aot_warmup``: lower every serving
         bucket with SHARDED avals (batch rows over ``dp`` via the
@@ -635,6 +676,51 @@ class TpuEmbedder:
                 f"{tag} packed {pb}x{l_tokens}/k{k_segs}",
                 _time.perf_counter() - t0,
             ))
+        # long-context ring buckets (N, S): only meaningful with an sp
+        # mesh axis — without one the ring shard_map has no axis to ring
+        # over, and warming nothing here keeps the 2-axis AOT table
+        # byte-identical to the pre-sp serving path
+        if ring_buckets and self.ring_available():
+            from ..parallel.ring import _ring_embed_and_vote, _ring_embed_jit
+
+            sp = self.mesh_sp
+            rtag = f"mesh {dp}x{tp}x{sp}"
+
+            def rav(rows, cols):
+                return sds(
+                    (rows, cols), jnp.int32, sharding=self.ring_sharding
+                )
+
+            for n, s in ring_buckets:
+                s = _seq_bucket(s, self.ring_max_tokens)
+                s = min(s + (-s) % sp, self.ring_max_tokens)
+                key = self._ring_aot_key(("ring_vote", n, s))
+                if key not in self._aot:
+                    pad_n = n + (-n) % bm
+                    t0 = _time.perf_counter()
+                    self._aot[key] = _ring_embed_and_vote.lower(
+                        self.params, rav(pad_n, s), rav(pad_n, s), temp_av,
+                        n, self._ring_config, self.mesh, "sp", "dp",
+                        self.pooling,
+                    ).compile()
+                    timings.append((
+                        f"{rtag} ring consensus {n}x{s}",
+                        _time.perf_counter() - t0,
+                    ))
+                pad_b = _bucket(n, self.MAX_DEVICE_BATCH)
+                pad_b += (-pad_b) % bm
+                key = self._ring_aot_key(("ring", pad_b, s))
+                if key not in self._aot:
+                    t0 = _time.perf_counter()
+                    self._aot[key] = _ring_embed_jit.lower(
+                        self.params, rav(pad_b, s), rav(pad_b, s),
+                        self._ring_config, self.mesh, "sp", "dp",
+                        self.pooling, True,
+                    ).compile()
+                    timings.append((
+                        f"{rtag} ring embed {pad_b}x{s}",
+                        _time.perf_counter() - t0,
+                    ))
         return timings
 
     def aot_mesh_shapes(self) -> list:
@@ -653,6 +739,8 @@ class TpuEmbedder:
         """Jit-cache introspection: AOT bucket count + per-entry-point
         specialization counts (serve /metrics "jit" section; the warmup
         test asserts the counts stay flat under post-warmup load)."""
+        from ..parallel.ring import _ring_embed_and_vote, _ring_embed_jit
+
         return {
             "aot_buckets": len(self._aot),
             "specializations": {
@@ -665,6 +753,8 @@ class TpuEmbedder:
                     _stream_vote_update_many._cache_size()
                 ),
                 "embed_packed": bert.embed_packed._cache_size(),
+                "ring_embed": _ring_embed_jit._cache_size(),
+                "ring_embed_and_vote": _ring_embed_and_vote._cache_size(),
             },
         }
 
@@ -727,6 +817,120 @@ class TpuEmbedder:
             ),
         )
         return self._finish(emb[:b])
+
+    # -- long-context ring (sequence-parallel) path ---------------------------
+
+    def _stage_ring(self, *arrays):
+        """Stage host int32 arrays for a ring dispatch: rows over ``dp``
+        AND the sequence axis over ``sp`` (the sharding the ring
+        executables baked).  Callers pad batch to the dp multiple and
+        sequence to an sp multiple first, so the split always divides."""
+        return tuple(
+            jax.device_put(np.asarray(a), self.ring_sharding)
+            for a in arrays
+        )
+
+    def _require_ring(self):
+        if not self.ring_available():
+            raise RuntimeError(
+                "ring dispatch needs first-class mesh mode on a mesh "
+                "with an sp axis (MESH_SHAPE=dp,tp,sp + "
+                "shard_embedder_mesh)"
+            )
+
+    def _ring_pad_seq(self, ids, mask):
+        """Pad the sequence axis to an sp multiple (pads are masked
+        keys; ring attention ignores them)."""
+        pad_s = (-ids.shape[1]) % self.mesh_sp
+        if pad_s:
+            ids = np.pad(np.asarray(ids), ((0, 0), (0, pad_s)))
+            mask = np.pad(np.asarray(mask), ((0, 0), (0, pad_s)))
+        return ids, mask
+
+    def tokenize_ring(
+        self, texts: Iterable[str], max_tokens: Optional[int] = None
+    ):
+        """``tokenize`` for the ring path: caps at ``ring_max_tokens``
+        (the position window rounded down to an sp multiple) instead of
+        the dense ``max_tokens``, and rounds the trimmed sequence bucket
+        UP to an sp multiple so the dispatch never re-pads."""
+        self._require_ring()
+        sp = self.mesh_sp
+        cap = min(max_tokens or self.ring_max_tokens, self.ring_max_tokens)
+        cap = max((cap // sp) * sp, sp)
+        ids, mask = self.tokenizer.encode_batch(list(texts), cap)
+        seq = _seq_bucket(int(mask.sum(axis=1).max(initial=1)), cap)
+        seq = min(seq + (-seq) % sp, cap)
+        return ids[:, :seq], mask[:, :seq]
+
+    def embed_tokens_ring(self, ids: np.ndarray, mask: np.ndarray):
+        """Long-context twin of ``embed_tokens``: the sequence axis is
+        sharded over ``sp`` and attention runs as a ring
+        (parallel/ring.py), so sequences up to ``ring_max_tokens`` —
+        beyond what one device's attention memory can serve — dispatch
+        as a single executable.  Same AOT-first contract, keyed under
+        the ("mesh", dp, tp, sp, "ring", b, s) namespace."""
+        self._require_ring()
+        b = ids.shape[0]
+        ids, mask = self._ring_pad_seq(ids, mask)
+        pad_b = _bucket(b, self.MAX_DEVICE_BATCH)
+        pad_b += (-pad_b) % self.batch_multiple
+        if pad_b != b:
+            ids, mask = self._stage_pad(ids, mask, pad_b)
+        s = ids.shape[1]
+        label = f"ring(b={pad_b},s={s})"
+        exe = self._aot_lookup(
+            self._ring_aot_key(("ring", pad_b, s)), ids, mask
+        )
+        dev_ids, dev_mask = self._stage_ring(ids, mask)
+        if exe is not None:
+            emb = self._timed_dispatch(
+                label, lambda: exe(self.params, dev_ids, dev_mask)
+            )
+            return self._finish(emb[:b])
+        from ..parallel.ring import _ring_embed_jit
+
+        emb = self._timed_dispatch(
+            label,
+            lambda: _ring_embed_jit(
+                self.params, dev_ids, dev_mask, self._ring_config,
+                self.mesh, "sp", "dp", self.pooling, True,
+            ),
+        )
+        return self._finish(emb[:b])
+
+    def consensus_confidence_tokens_ring(
+        self, ids: np.ndarray, mask: np.ndarray, temperature: float = 0.05
+    ):
+        """Long-context twin of ``consensus_confidence_tokens``:
+        sequence-sharded encoder + the dp-sharded consensus vote in one
+        dispatch (parallel.ring._ring_embed_and_vote).  Temperature is
+        always traced, pad rows masked via n_valid — the same contract
+        as the dense mesh vote."""
+        self._require_ring()
+        n = ids.shape[0]
+        ids, mask = self._ring_pad_seq(ids, mask)
+        ids, mask = self._pad_rows(ids, mask)
+        s = ids.shape[1]
+        label = f"ring_vote(n={n},s={s})"
+        exe = self._aot_lookup(
+            self._ring_aot_key(("ring_vote", n, s)), ids, mask
+        )
+        temp = self._stage_temp(temperature)
+        dev_ids, dev_mask = self._stage_ring(ids, mask)
+        if exe is not None:
+            return self._timed_dispatch(
+                label, lambda: exe(self.params, dev_ids, dev_mask, temp)
+            )
+        from ..parallel.ring import _ring_embed_and_vote
+
+        return self._timed_dispatch(
+            label,
+            lambda: _ring_embed_and_vote(
+                self.params, dev_ids, dev_mask, temp, n,
+                self._ring_config, self.mesh, "sp", "dp", self.pooling,
+            ),
+        )
 
     # -- packed (continuous-batching) path ------------------------------------
 

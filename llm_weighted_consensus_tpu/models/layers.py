@@ -55,29 +55,41 @@ def dense(x, p: dict):
 
 def dense_cfg(x, p: dict, config):
     """The layer-dense op under the config's quantize mode: param-dtype
-    matmul (dense above) or the W8A8 int8-MXU twin (models/quant.py) —
-    selected statically by ``config.quantize``, so the jit sees one path.
-    Shared by every model family (bert, deberta)."""
+    matmul (dense above), the W8A8 int8-MXU twin, or the packed-int4
+    W4A8 twin (both models/quant.py) — selected statically by
+    ``config.quantize``, so the jit sees one path.  Shared by every
+    model family (bert, deberta)."""
     if config.quantize.startswith("int8"):
         from .quant import dense_int8, impl_for
 
         return dense_int8(x, p, impl=impl_for(config.quantize))
+    if config.quantize.startswith("int4"):
+        from .quant import dense_int4, impl_for
+
+        return dense_int4(x, p, impl=impl_for(config.quantize))
     return dense(x, p)
 
 
 def mlp_cfg(x, p_in: dict, p_out: dict, config):
     """The encoder MLP (dense -> GELU -> dense) under the config's
     quantize mode.  Full precision keeps the dense/gelu_erf composition;
-    int8 modes route BOTH matmuls through dense_int8 with the GELU folded
-    into the expansion matmul's kernel epilogue (ops/kernels.w8a8_matmul)
-    — the [B*S, intermediate] GELU input never round-trips HBM between
-    separate quant/matmul/activation passes."""
+    int8/int4 modes route BOTH matmuls through their quantized dense
+    with the GELU folded into the expansion matmul's kernel epilogue
+    (ops/kernels.w8a8_matmul / w4a8_matmul) — the [B*S, intermediate]
+    GELU input never round-trips HBM between separate
+    quant/matmul/activation passes."""
     if config.quantize.startswith("int8"):
         from .quant import dense_int8, impl_for
 
         impl = impl_for(config.quantize)
         h = dense_int8(x, p_in, gelu=True, impl=impl)
         return dense_int8(h, p_out, impl=impl)
+    if config.quantize.startswith("int4"):
+        from .quant import dense_int4, impl_for
+
+        impl = impl_for(config.quantize)
+        h = dense_int4(x, p_in, gelu=True, impl=impl)
+        return dense_int4(h, p_out, impl=impl)
     return dense(gelu_erf(dense(x, p_in)), p_out)
 
 

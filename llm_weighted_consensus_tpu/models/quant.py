@@ -70,11 +70,11 @@ def _quantize_rows(x: jax.Array):
     return q, scale
 
 
-QUANT_MODES = ("none", "int8", "int8-pallas", "int8-xla")
+QUANT_MODES = ("none", "int8", "int8-pallas", "int8-xla", "int4-pallas")
 
 
 def impl_for(mode: str) -> str:
-    """Quantize mode string -> dense_int8 implementation name.
+    """Quantize mode string -> dense implementation name.
 
     Called at trace time, so the backend probe is a compile-time constant
     — the jit sees exactly one path."""
@@ -82,7 +82,12 @@ def impl_for(mode: str) -> str:
         return "pallas" if jax.default_backend() == "tpu" else "xla"
     if mode in ("int8-pallas", "int8-xla"):
         return mode[len("int8-"):]
-    raise ValueError(f"quantize={mode!r} is not an int8 mode")
+    if mode == "int4-pallas":
+        # one spelling: the packed layout exists FOR the fused kernel
+        # (non-TPU backends run it in interpret mode; the only XLA
+        # composition is the in-graph VMEM-overflow fallback)
+        return "pallas"
+    raise ValueError(f"quantize={mode!r} is not a quantized mode")
 
 
 def dense_int8(
@@ -127,16 +132,100 @@ def dense_int8(
     return out
 
 
+def quantize_weight_int4(kernel: jax.Array):
+    """kernel[..., in, out] (f32/bf16) -> (packed uint8 kernel,
+    f32 scale[..., out]).
+
+    Per-output-channel symmetric int4: scale = max|W[:,o]|/7, values
+    clipped to [-7, 7], packed two-per-byte along K in the split-K
+    biased-nibble layout of ops/kernels.pack_int4_weights (which is also
+    the layout ``w4a8_matmul`` unpacks in-kernel).  2x less HBM/VMEM
+    than int8 — the headroom the long-context ring path spends on
+    activations."""
+    from ..ops.kernels import pack_int4_weights
+
+    k32 = kernel.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(k32), axis=-2) / 7.0  # [..., out]
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.round(k32 / scale[..., None, :])
+    q = jnp.clip(q, -7, 7).astype(jnp.int8)
+    return pack_int4_weights(q), scale
+
+
+def _unpack_int4(wq4: jax.Array, k: int) -> jax.Array:
+    """Packed uint8 [Kp/2, N] -> int8 [k, N] (the XLA-composition twin of
+    the in-kernel unpack; XLA constant-folds it over the frozen weights)."""
+    w32 = wq4.astype(jnp.int32)
+    lo = ((w32 & 0xF) - 8).astype(jnp.int8)
+    hi = ((w32 >> 4) - 8).astype(jnp.int8)
+    return jnp.concatenate([lo, hi], axis=0)[:k]
+
+
+def dense_int4(
+    x: jax.Array, p: dict, *, gelu: bool = False, impl: str = None
+) -> jax.Array:
+    """W4A8 dense: x[..., in] @ unpack(p["kernel_q"])[in, out] -> [..., out].
+
+    The packed-int4 twin of ``dense_int8``: same per-row dynamic int8
+    activations, same int32 MXU accumulation, same rank-1 dequant — the
+    weight block just decodes from nibbles.  The pallas impl unpacks
+    IN-KERNEL (ops/kernels.w4a8_matmul) so the int8 weight copy never
+    materializes; shapes past the shared VMEM gate fall back to the XLA
+    composition over an unpacked weight."""
+    if impl is None:
+        impl = impl_for("int4-pallas")
+    k = x.shape[-1]
+    n = p["kernel_q"].shape[-1]
+    if impl == "pallas":
+        from ..ops.kernels import w4a8_matmul, w8a8_shape_fits
+
+        m = 1
+        for d in x.shape[:-1]:
+            m *= d
+        if w8a8_shape_fits(
+            m, k, n, jnp.dtype(x.dtype).itemsize, w_bytes=0.5
+        ):
+            return w4a8_matmul(
+                x, p["kernel_q"], p["scale"], p["bias"], gelu=gelu
+            )
+        # weight block too big for VMEM: the XLA composition below
+    wq = _unpack_int4(p["kernel_q"], k)
+    xq, sx = _quantize_rows(x)
+    acc = jax.lax.dot_general(
+        xq,
+        wq,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * sx[..., None] * p["scale"]
+    out = out.astype(x.dtype) + p["bias"]
+    if gelu:
+        from .layers import gelu_erf
+
+        out = gelu_erf(out)
+    return out
+
+
 _QUANT_LAYER_KERNELS = (
     "attn_q", "attn_k", "attn_v", "attn_out", "mlp_in", "mlp_out"
 )
 
 
 def is_quantized(params: dict) -> bool:
-    """Whether a bert param pytree carries the int8 layout — the ONE
+    """Whether a bert param pytree carries a quantized layout — the ONE
     structural probe (callers must not re-invent it: layout changes then
     surface here, not as a silent misdetection at a second site)."""
     return "kernel_q" in params.get("layers", {}).get("attn_q", {})
+
+
+def is_int4(params: dict) -> bool:
+    """Whether a quantized pytree carries the PACKED int4 layout (uint8
+    nibbles) rather than int8 — same single-probe contract as
+    ``is_quantized``."""
+    leaf = params.get("layers", {}).get("attn_q", {})
+    return (
+        "kernel_q" in leaf and leaf["kernel_q"].dtype == jnp.uint8
+    )
 
 
 def quantize_bert_params(params: dict) -> dict:
@@ -164,6 +253,24 @@ def quantize_bert_params(params: dict) -> dict:
 quantize_deberta_params = quantize_bert_params
 
 
+def quantize_bert_params_int4(params: dict) -> dict:
+    """bert param pytree -> its packed-int4 twin (same six layer dense
+    kernels as the int8 path; same leaf names, so the partition rules
+    and the JXA006 coverage audit apply unchanged)."""
+    layers = dict(params["layers"])
+    for name in _QUANT_LAYER_KERNELS:
+        leaf = layers[name]
+        kq4, scale = quantize_weight_int4(leaf["kernel"])
+        layers[name] = {
+            "kernel_q": kq4,
+            "scale": scale,
+            "bias": leaf["bias"],
+        }
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
 def resolve_quantize(config, params: dict, quantize: str):
     """The ONE quantize-mode entry point for model constructors
     (TpuEmbedder, TpuReranker): validates the mode, stamps it on the
@@ -179,6 +286,18 @@ def resolve_quantize(config, params: dict, quantize: str):
     import dataclasses
 
     config = dataclasses.replace(config, quantize=quantize)
-    if not is_quantized(params):
-        params = quantize_bert_params(params)
+    want_int4 = quantize.startswith("int4")
+    if is_quantized(params):
+        if is_int4(params) != want_int4:
+            raise ValueError(
+                f"quantize={quantize!r} but params carry the "
+                f"{'int4' if is_int4(params) else 'int8'} layout — "
+                "re-load full-precision params to switch schemes"
+            )
+        return config, params
+    params = (
+        quantize_bert_params_int4(params)
+        if want_int4
+        else quantize_bert_params(params)
+    )
     return config, params

@@ -234,24 +234,39 @@ def fused_attention_tiled_seg(
 
 
 def best_heads_per_step(
-    b: int, s: int, nh: int, hd: int, itemsize: int = 2
+    b: int,
+    s: int,
+    nh: int,
+    hd: int,
+    itemsize: int = 2,
+    score_itemsize: int = 4,
+    bias_itemsize: int = 4,
 ) -> int:
     """Largest power-of-two divisor of b*nh whose block set fits VMEM,
     or 0 if not even a 1-tile step fits (callers fall back to einsum).
 
     Per step the kernel holds 4 [k, s, hd] operand/output blocks in the
     storage dtype (``itemsize`` bytes/element, x2 for double-buffering),
-    the [k, s, s] f32 score/prob tiles, and the bias row.  11 MB of the
-    ~16 MB VMEM admits the measured-best tiles (bf16: kk=32 @ s=128:
-    8.4 MB; kk=4 @ s=512: 10.5 MB) and rejects the ones Mosaic refuses
-    or that regress from double-buffer pressure (kk=64 @ s=128: 16.8 MB).
+    the [k, s, s] score/prob tiles (``score_itemsize``, f32 today), and
+    the bias row (``bias_itemsize``; the packed variant's int32 segment
+    row has the same width).  The per-dtype byte widths are parameters
+    — not baked-in 4s — so a narrower score accumulator or bias layout
+    reuses this one fit model, mirroring ``w8a8_shape_fits``'s
+    ``w_bytes``.  11 MB of the ~16 MB VMEM admits the measured-best
+    tiles (bf16: kk=32 @ s=128: 8.4 MB; kk=4 @ s=512: 10.5 MB) and
+    rejects the ones Mosaic refuses or that regress from double-buffer
+    pressure (kk=64 @ s=128: 16.8 MB).
     """
     budget = 11 * 1024 * 1024
     best = 0
     kk = 1
     while kk <= b * nh:
         if (b * nh) % kk == 0:
-            need = kk * (8 * s * hd * itemsize + 2 * s * s * 4 + s * 4)
+            need = kk * (
+                8 * s * hd * itemsize
+                + 2 * s * s * score_itemsize
+                + s * bias_itemsize
+            )
             if need <= budget:
                 best = kk
         kk *= 2

@@ -121,17 +121,24 @@ def _round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
 
 
-def w8a8_shape_fits(m: int, k: int, n: int, x_bytes: int) -> bool:
+def w8a8_shape_fits(
+    m: int, k: int, n: int, x_bytes: int, w_bytes: float = 1.0
+) -> bool:
     """Whether the single-weight-block tiling fits the VMEM budget.
 
     Every preset's encoder matmul fits (bge-large mlp_in, the largest:
     1 MB x-tile + 4 MB int8 weights + 4 MB f32 out-tile, double-buffered
     tiles well under 12 MB); the gate exists for hypothetical huge
-    projections, which fall back to the XLA int8 dot_general."""
+    projections, which fall back to the XLA int8 dot_general.
+
+    ``w_bytes`` is the resident weight block's bytes per element — 1 for
+    the int8 kernel, 0.5 for the packed-int4 kernel (two weights per
+    uint8 byte) — so both quantized paths share this one gate instead of
+    diverging copies."""
     kp = _round_up(k, 128)
     np_ = _round_up(n, 128)
     tm = min(W8A8_TILE_M, _round_up(m, 8))
-    weight = kp * np_  # int8: 1 byte
+    weight = int(kp * np_ * w_bytes)  # int8: 1 byte; packed int4: 1/2
     tiles = 2 * tm * kp * x_bytes + 2 * tm * np_ * x_bytes  # double-buffered
     scale_bias = 2 * np_ * 4
     return weight + tiles + scale_bias <= _W8A8_VMEM_BUDGET
@@ -209,4 +216,121 @@ def w8a8_matmul(
         out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
         interpret=_interpret() if interpret is None else interpret,
     )(xp, wqp, swp, bp)
+    return out[:m, :n].reshape(*x.shape[:-1], n)
+
+
+# ---------------------------------------------------------------------------
+# Fused W4A8 quantized matmul (packed int4 weights, the long-context path)
+# ---------------------------------------------------------------------------
+
+# Packed layout contract (shared with models/quant.py): an int4 kernel
+# [K, N] is stored as ONE uint8 array [Kp/2, N] where Kp = K rounded up
+# to a multiple of 2*128 (so each nibble half stays lane-aligned).  The
+# LOW nibble of row kk holds weight row kk; the HIGH nibble holds weight
+# row kk + Kp/2 (split-K halves, not interleaved pairs — the kernel then
+# needs no gather/concat, just two lane-aligned dot_generals).  Nibbles
+# are stored biased by +8: quantized values live in [-7, 7], stored as
+# [1, 15], and the zero-pad nibble is 8 (unbiases to exactly 0, so K
+# padding contributes nothing to the accumulator).
+W4A8_PACK_K = 256
+
+
+def pack_int4_weights(wq: jax.Array) -> jax.Array:
+    """Pack a per-channel int4-quantized kernel ``wq[..., K, N]`` (int8
+    values in [-7, 7]) into the biased-nibble uint8 layout above along
+    the K axis (leading dims — e.g. a stacked per-layer kernel — ride
+    through untouched)."""
+    k = wq.shape[-2]
+    kp = _round_up(k, W4A8_PACK_K)
+    half = kp // 2
+    biased = (wq.astype(jnp.int32) + 8).astype(jnp.uint8)
+    pad = [(0, 0)] * wq.ndim
+    pad[-2] = (0, kp - k)
+    biased = jnp.pad(biased, pad, constant_values=8)
+    lo = biased[..., :half, :]
+    hi = biased[..., half:, :]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def _w4a8_kernel(x_ref, wq_ref, sw_ref, b_ref, o_ref, *, gelu, approx_gelu):
+    # lazy: ops must not import models at module load (models/__init__
+    # imports embedder, which imports this module)
+    from ..models.layers import gelu_f32
+
+    x = x_ref[:].astype(jnp.float32)  # [TM, Kp]; pad rows/cols are zero
+    # the SAME per-row dynamic activation quant as the W8A8 kernel — the
+    # two paths differ only in how the weight block decodes
+    sx = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0  # [TM, 1]
+    sx = jnp.maximum(sx, 1e-12)
+    xq = jnp.clip(jnp.round(x / sx), -127.0, 127.0).astype(jnp.int8)
+    # unpack via int32 (Mosaic's comfortable width for bit ops), then
+    # narrow to int8 for the MXU
+    packed = wq_ref[:].astype(jnp.int32)  # [Kp/2, Np], biased nibbles
+    lo = ((packed & 0xF) - 8).astype(jnp.int8)  # weight rows [0, Kp/2)
+    hi = ((packed >> 4) - 8).astype(jnp.int8)  # weight rows [Kp/2, Kp)
+    half = packed.shape[0]
+    acc = jax.lax.dot_general(
+        xq[:, :half],
+        lo,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ) + jax.lax.dot_general(
+        xq[:, half:],
+        hi,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [TM, Np] int32 on the MXU, exact
+    # identical epilogue to _w8a8_kernel: rank-1 dequant + bias (+ GELU)
+    out = acc.astype(jnp.float32) * sx * sw_ref[:] + b_ref[:]
+    if gelu:
+        out = gelu_f32(out, approx=approx_gelu)
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+def w4a8_matmul(
+    x: jax.Array,
+    wq4: jax.Array,
+    sw: jax.Array,
+    bias: jax.Array,
+    *,
+    gelu: bool = False,
+    interpret=None,
+) -> jax.Array:
+    """Fused W4A8 dense: ``x[..., K] @ unpack(wq4)[K, N] -> [..., N]``.
+
+    ``wq4`` is the packed biased-nibble uint8 kernel from
+    ``pack_int4_weights`` and ``sw`` its per-output-channel f32 scale
+    (max|W|/7); activations are quantized to int8 per row INSIDE the
+    kernel.  Reuses the W8A8 grid and epilogue; the weight block is half
+    the VMEM of int8, which is what buys long-context activations room
+    next to the weights.  Non-TPU backends run in interpret mode."""
+    k = x.shape[-1]
+    n = wq4.shape[-1]
+    kp = 2 * wq4.shape[0]  # pack-time K padding (multiple of 256)
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    tm = min(W8A8_TILE_M, _round_up(m, 8))
+    xp = jnp.pad(_pad_to(x2, 0, tm), ((0, 0), (0, kp - k)))
+    wq4p = _pad_to(wq4, 1, 128)
+    swp = _pad_to(sw.astype(jnp.float32).reshape(1, n), 1, 128)
+    bp = _pad_to(bias.astype(jnp.float32).reshape(1, n), 1, 128)
+    mp = xp.shape[0]
+    np_ = wq4p.shape[1]
+    out = pl.pallas_call(
+        functools.partial(
+            _w4a8_kernel,
+            gelu=gelu,
+            approx_gelu=x.dtype == jnp.bfloat16,
+        ),
+        grid=(mp // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, kp), lambda i: (i, 0)),
+            pl.BlockSpec((kp // 2, np_), lambda i: (0, 0)),
+            pl.BlockSpec((1, np_), lambda i: (0, 0)),
+            pl.BlockSpec((1, np_), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tm, np_), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(xp, wq4p, swp, bp)
     return out[:m, :n].reshape(*x.shape[:-1], n)

@@ -15,31 +15,47 @@ def make_mesh(
     tp: int = 1,
     devices: Optional[list] = None,
     names: tuple = ("dp", "tp"),
+    sp: int = 1,
 ) -> Mesh:
     """A 2-axis mesh over the available devices (axis names default to
     (dp, tp); sequence-parallel serving reuses this with ("dp", "sp")).
 
-    ``dp=None`` takes every device not consumed by the inner axis.  On
+    ``sp > 1`` opts into a third, innermost sequence-parallel axis: the
+    grid becomes (dp, tp, sp) with names ``names + ("sp",)``.  ``sp=1``
+    is byte-identical to the historical 2-axis mesh — no third axis is
+    materialized, so every (dp, tp) consumer downstream is untouched.
+
+    ``dp=None`` takes every device not consumed by the inner axes.  On
     real slices the device order from ``jax.devices()`` follows the ICI
-    torus, so neighboring inner-axis groups ride the fastest links.
+    torus, so neighboring inner-axis groups ride the fastest links —
+    with sp innermost, the ring permutation stays on nearest neighbors.
     """
     devices = list(devices if devices is not None else jax.devices())
     if tp < 1:  # before the auto-fill division below
         raise ValueError(f"mesh axes must be >= 1, got {names[1]}={tp}")
+    if sp < 1:
+        raise ValueError(f"mesh axes must be >= 1, got sp={sp}")
+    inner = tp * sp
     if dp is None:
-        dp = len(devices) // tp
+        dp = len(devices) // inner
     if dp < 1:
-        # include the other axis: an auto-filled dp=0 means the INNER axis
-        # exceeded the device count, which is the user's actual mistake
+        # include the other axes: an auto-filled dp=0 means the INNER
+        # axes exceeded the device count, which is the user's actual
+        # mistake
         raise ValueError(
             f"mesh axes must be >= 1, got {names[0]}={dp} {names[1]}={tp} "
-            f"over {len(devices)} devices"
+            + (f"sp={sp} " if sp > 1 else "")
+            + f"over {len(devices)} devices"
         )
-    n = dp * tp
+    n = dp * inner
     if n > len(devices):
+        shape = f"{dp}x{tp}" + (f"x{sp}" if sp > 1 else "")
         raise ValueError(
-            f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}"
+            f"mesh {shape} needs {n} devices, have {len(devices)}"
         )
+    if sp > 1:
+        grid = np.array(devices[:n]).reshape(dp, tp, sp)
+        return Mesh(grid, tuple(names) + ("sp",))
     grid = np.array(devices[:n]).reshape(dp, tp)
     return Mesh(grid, names)
 

@@ -179,6 +179,32 @@ def _ring_embed_jit(
     return bert.pool(hidden, mask, pooling, normalize)
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n", "config", "mesh", "sp_axis", "dp_axis", "pooling"
+    ),
+)
+def _ring_embed_and_vote(
+    params, ids, mask, temperature, n, config, mesh, sp_axis, dp_axis, pooling
+):
+    """Ring-dispatch twin of ``_mesh_embed_and_vote`` (models/embedder.py):
+    sequence-sharded encoder forward + pooling + the dp-sharded consensus
+    reduction under ONE jit, so a long-context scored request pays one
+    dispatch.  The pooled embeddings leave the ring shard_map sharded
+    (batch over dp, the contracted seq axis reduced over sp by GSPMD);
+    the vote's shard_map re-enters over dp with sp/tp implicitly
+    replicated.  Temperature is always traced — same no-recompile
+    contract as the dense mesh vote."""
+    from ..models import bert
+    from .collectives import sharded_cosine_vote
+
+    hidden = ring_encode(params, ids, mask, config, mesh, sp_axis, dp_axis)
+    emb = bert.pool(hidden, mask, pooling, True)
+    with jax.named_scope("consensus_vote"):
+        return sharded_cosine_vote(emb, mesh, temperature, n_valid=n)
+
+
 def ring_embed(
     params: dict,
     input_ids: jax.Array,

@@ -29,6 +29,19 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp", None))
 
 
+def ring_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input sharding for the long-context ring dispatch: batch rows over
+    ``dp`` AND the sequence axis over ``sp`` — the sp-aware twin of
+    ``batch_sharding``.  Requires a 3-axis (dp, tp, sp) mesh
+    (``make_mesh(..., sp=N)``)."""
+    if "sp" not in mesh.shape:
+        raise ValueError(
+            "ring_batch_sharding needs an 'sp' mesh axis "
+            f"(got axes {tuple(mesh.shape)})"
+        )
+    return NamedSharding(mesh, P("dp", "sp"))
+
+
 # Leading axis of every stacked layer param is the layer index (scanned) —
 # shardings below apply to [layer, in, out] kernels / [layer, dim] biases.
 _TP_LAYER_SPECS = {
@@ -292,11 +305,23 @@ def shard_embedder_mesh(embedder, mesh: Mesh) -> None:
     executables with the input shardings baked in (models/embedder.py).
     Unlike ``shard_embedder`` (the hook path above, which disables AOT
     and packing), mesh mode keeps both.
+
+    With an ``sp`` axis on the mesh (``MESH_SHAPE=dp,tp,sp``), the dense
+    dispatch path is UNCHANGED — same shardings, same batch_multiple,
+    same AOT keys as the 2-axis mesh (short traffic replicates over sp)
+    — and the embedder additionally gains the ring dispatch state:
+    ``mesh_sp``, a (dp, sp)-sharded input sharding, the ring token cap
+    (position window rounded down to an sp multiple), and a ring-mode
+    twin of its config.  ``MESH_SHAPE`` without sp therefore stays
+    byte-identical to the pre-sp serving path.
     """
+    import dataclasses
+
     from ..models.quant import is_quantized
 
     dp = mesh.shape["dp"]
     tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get("sp", 1)
     rules = bert_partition_rules(quantized=is_quantized(embedder.params))
     embedder.params = shard_by_rules(embedder.params, mesh, rules, tp=tp > 1)
     b_sharding = batch_sharding(mesh)
@@ -313,6 +338,23 @@ def shard_embedder_mesh(embedder, mesh: Mesh) -> None:
     embedder.batch_sharding = b_sharding
     embedder.repl_sharding = repl
     embedder.mesh_mode = True
+    embedder.mesh_sp = sp
+    if sp > 1:
+        from ..models.configs import usable_positions
+
+        embedder.ring_sharding = ring_batch_sharding(mesh)
+        # ring sequences pad to an sp multiple; cap so padding can never
+        # push past the position table (same contract as shard_embedder_sp)
+        embedder.ring_max_tokens = (
+            usable_positions(embedder.config) // sp
+        ) * sp
+        embedder._ring_config = dataclasses.replace(
+            embedder.config, attention_impl="ring", ring_axis="sp"
+        )
+    else:
+        embedder.ring_sharding = None
+        embedder.ring_max_tokens = None
+        embedder._ring_config = None
 
 
 def shard_reranker_mesh(reranker, mesh: Mesh) -> None:

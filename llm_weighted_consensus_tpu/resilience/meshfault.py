@@ -314,6 +314,10 @@ class MeshFaultManager:
     ) -> None:
         self.embedder = embedder
         self.full_shape = (int(shape[0]), int(shape[1]))
+        # sequence-parallel width (MESH_SHAPE=dp,tp,sp): preserved down
+        # every rung like tp — the ladder halves dp only, so a degraded
+        # mesh keeps serving long-context ring traffic
+        self.sp = int(getattr(embedder, "mesh_sp", 1) or 1)
         self.transient_retries = int(transient_retries)
         self.probe_millis = float(probe_millis)
         self.fault_plan = fault_plan
@@ -360,17 +364,22 @@ class MeshFaultManager:
             )
         devices = list(full_mesh.devices.reshape(-1))
         dp, tp = self.full_shape
+        sp = self.sp
         self._rungs = [_Rung(dp, tp, full_mesh, devices)]
         step = dp // 2
         while step >= 1:
-            sub = devices[: step * tp]
-            mesh = make_mesh(dp=step, tp=tp, devices=sub)
+            sub = devices[: step * tp * sp]
+            mesh = make_mesh(dp=step, tp=tp, sp=sp, devices=sub)
             self._rungs.append(_Rung(step, tp, mesh, sub))
             step //= 2
         return [(r.dp, r.tp) for r in self._rungs]
 
     def warm_ladder(
-        self, specs: list, r_buckets: list = (), packed_buckets: list = ()
+        self,
+        specs: list,
+        r_buckets: list = (),
+        packed_buckets: list = (),
+        ring_buckets: list = (),
     ) -> list:
         """AOT-warm every fallback rung so a downsize never compiles.
 
@@ -391,7 +400,7 @@ class MeshFaultManager:
                 shard_embedder_mesh(self.embedder, rung.mesh)
                 timings.extend(
                     self.embedder.aot_warmup(
-                        specs, r_buckets, packed_buckets
+                        specs, r_buckets, packed_buckets, ring_buckets
                     )
                 )
         return timings
@@ -643,6 +652,7 @@ class MeshFaultManager:
             snap = {
                 "current_shape": list(self.current_shape),
                 "full_shape": list(self.full_shape),
+                "sp": self.sp,
                 "degraded": self.degraded,
                 "epoch": self._epoch,
                 "downsizes": self._downsizes,

@@ -361,6 +361,7 @@ def build_embedder(config: Config, allow_synthetic: bool = False):
         mesh = make_mesh(
             dp=shape[0] if shape else None,
             tp=shape[1] if shape else 1,
+            sp=shape[2] if shape and len(shape) > 2 else 1,
             devices=jax.local_devices(),
         )
         shard_embedder_mesh(embedder, mesh)
@@ -581,6 +582,7 @@ def _warmup_embedder(
     r_buckets: list = (),
     aot: bool = True,
     packed_buckets: list = (),
+    ring_buckets: list = (),
 ) -> None:
     """Pre-compile the consensus path for the given ``NxS`` shapes at
     startup (WARMUP env, serve/config.py) so the first real request
@@ -611,7 +613,11 @@ def _warmup_embedder(
     knobs) additionally warms the continuous-batching entry
     (``bert.embed_packed``) at each packed-capacity bucket — the small
     fixed set replacing the (R, N, S) lattice on the packed path.  AOT
-    only: packing requires the single-device or mesh-mode embedder."""
+    only: packing requires the single-device or mesh-mode embedder.
+
+    ``ring_buckets`` (LONG_CONTEXT_WARMUP NxS specs) warms the
+    sequence-parallel ring dispatch on an sp-bearing mesh — AOT only,
+    and a no-op unless the embedder's mesh carries an sp axis."""
     import logging
     import time as _time
 
@@ -629,7 +635,10 @@ def _warmup_embedder(
     )
     if aot and embedder._aot_ready():
         for label, dt in embedder.aot_warmup(
-            snapped, r_buckets, packed_buckets=packed_buckets
+            snapped,
+            r_buckets,
+            packed_buckets=packed_buckets,
+            ring_buckets=ring_buckets,
         ):
             log.info("warmup AOT %s compiled in %.1fs", label, dt)
         return
@@ -803,6 +812,7 @@ def build_service(
             config.warmup_r,
             aot=config.warmup_aot,
             packed_buckets=packed_buckets,
+            ring_buckets=config.long_context_warmup,
         )
     # mesh fault domains (MESH_FAULT_ENABLED, resilience/meshfault.py):
     # the downsize ladder is declared — and every fallback rung AOT-warmed
@@ -841,7 +851,10 @@ def build_service(
                 )
             )
             for label, dt in meshfault.warm_ladder(
-                snapped, config.warmup_r, packed_buckets
+                snapped,
+                config.warmup_r,
+                packed_buckets,
+                config.long_context_warmup,
             ):
                 _mf_log.info(
                     "mesh fault ladder AOT %s compiled in %.1fs", label, dt
@@ -891,11 +904,15 @@ def build_service(
 
         def _mesh_stats():
             dp, tp = embedder.mesh_shape
+            sp = getattr(embedder, "mesh_sp", 1)
             return {
                 "enabled": True,
                 "dp": dp,
                 "tp": tp,
-                "devices": dp * tp,
+                "sp": sp,
+                "devices": dp * tp * sp,
+                "ring": bool(embedder.ring_available()),
+                "ring_max_tokens": embedder.ring_max_tokens,
                 "aot_buckets": sum(
                     1 for key in embedder._aot if key and key[0] == "mesh"
                 ),

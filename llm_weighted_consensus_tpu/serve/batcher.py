@@ -287,6 +287,17 @@ class DeviceBatcher:
         than recomputed, and only genuinely new rows ride a dispatch.
         The public contract is unchanged either way."""
         texts = list(texts)
+        if await self._route_ring(texts, max_tokens):
+            # over-length request on a sequence-parallel mesh: the ring
+            # dispatch serves the FULL text where the dense path would
+            # truncate at max_tokens.  Bypasses the embed cache — its
+            # fingerprints assume dense truncation semantics, and a
+            # full-length vector under the same (text, cap) key would
+            # poison dense hits (and vice versa).
+            emb, row_tokens = await self._submit(
+                "ring_embed", ("ring_embed", max_tokens), (texts, max_tokens)
+            )
+            return emb, int(np.asarray(row_tokens).sum())
         key = self._embed_key(max_tokens)
         cache = self.embed_cache
         if cache is None or not cache.enabled or not texts:
@@ -378,7 +389,19 @@ class DeviceBatcher:
         with same-N same-temperature requests via
         ``consensus_confidence_tokens_many`` — or, with packing enabled,
         with EVERY other packed-eligible item regardless of N and
-        temperature (the packed dispatch votes per item on host)."""
+        temperature (the packed dispatch votes per item on host).
+
+        Over-length candidate sets on a sequence-parallel mesh route to
+        the ring dispatch instead (full-length scoring, no truncation)
+        — bypassing the packed key too: a packed row is capped at the
+        dense window, so an over-length segment can never ride it."""
+        texts = list(texts)
+        if await self._route_ring(texts):
+            return await self._submit(
+                "ring_vote",
+                ("ring_vote", len(texts), float(temperature)),
+                (texts, temperature),
+            )
         key = (
             ("packed",)
             if self.packing
@@ -387,7 +410,7 @@ class DeviceBatcher:
         return await self._submit(
             "consensus",
             key,
-            (list(texts), temperature),
+            (texts, temperature),
         )
 
     def _embed_key(self, max_tokens):
@@ -398,6 +421,40 @@ class DeviceBatcher:
         if self.packing:
             return ("packed",)
         return ("embed", max_tokens)
+
+    async def _route_ring(
+        self, texts: list, max_tokens: Optional[int] = None
+    ) -> bool:
+        """Whether this request should ride the long-context ring
+        dispatch: the embedder serves a sequence-parallel mesh AND at
+        least one text exceeds the dense token window.
+
+        The gateway never sends a length cap, so routing keys off the
+        ACTUAL text length.  Two tiers keep the common case free:
+        ``len(text) + 2`` is an upper bound on the wordpiece token count
+        (every token consumes >= 1 character, plus [CLS]/[SEP]), so any
+        request under the window in characters is dense with zero extra
+        work; only plausibly-long requests pay a precise tokenization,
+        run OFF the event loop on the host tokenizer pool.  An explicit
+        ``max_tokens`` at or under the dense window is an intentional
+        truncation request and stays dense."""
+        embedder = self.embedder
+        if not texts or not getattr(
+            embedder, "ring_available", lambda: False
+        )():
+            return False
+        cap = embedder.max_tokens
+        if max_tokens is not None and int(max_tokens) <= cap:
+            return False
+        if all(len(t) + 2 <= cap for t in texts):
+            return False
+        loop = asyncio.get_running_loop()
+
+        def over_length() -> bool:
+            _, mask = embedder.tokenize_ring(texts, max_tokens)
+            return int(mask.sum(axis=1).max(initial=0)) > cap
+
+        return await loop.run_in_executor(self._tok_pool, over_length)
 
     async def stream_update(
         self,
@@ -571,7 +628,9 @@ class DeviceBatcher:
         loop = asyncio.get_running_loop()
         future = loop.create_future()
         item = _Item(kind, key, payload, future, current_deadline(), span)
-        if self._tok_pool is not None and kind in ("embed", "consensus"):
+        if self._tok_pool is not None and kind in (
+            "embed", "consensus", "ring_embed", "ring_vote"
+        ):
             # submit-time tokenization: the item's rows (or packed plan)
             # build on the host pool NOW, overlapping earlier groups'
             # device time; tokenizer errors park in the future and
@@ -961,7 +1020,7 @@ class DeviceBatcher:
     @staticmethod
     def _rows(item) -> int:
         """Encoder rows one item contributes to its dispatch."""
-        if item.kind in ("embed", "consensus"):
+        if item.kind in ("embed", "consensus", "ring_embed", "ring_vote"):
             return max(1, len(item.payload[0]))
         return 1  # stream: one new candidate per update
 
@@ -1113,6 +1172,12 @@ class DeviceBatcher:
         if kind == "embed":
             texts, cap = payload
             return self.embedder.tokenize(texts, cap)
+        if kind == "ring_embed":
+            texts, cap = payload
+            return self.embedder.tokenize_ring(texts, cap)
+        if kind == "ring_vote":
+            texts, _temperature = payload
+            return self.embedder.tokenize_ring(texts)
         texts, _temperature = payload
         return self.embedder.tokenize(texts)
 
@@ -1223,6 +1288,73 @@ class DeviceBatcher:
         def finalize() -> list:
             conf_np = np.asarray(conf)
             return [(conf_np[i], int(tokens[i])) for i in range(r)]
+
+        return finalize
+
+    # -- long-context ring dispatch -------------------------------------------
+
+    def _dispatch_ring_embed(self, group: list, embedder):
+        """Over-length embed items -> full-length embeddings via the
+        sequence-parallel ring dispatch (``embed_tokens_ring``).  Only
+        the primary embedder carries the sp mesh; on the CPU twin the
+        group falls back to the dense (truncating) dispatch — degraded
+        but serving, the same contract every other kind has there."""
+        if not getattr(embedder, "ring_available", lambda: False)():
+            return self._dispatch_embed(group, embedder)
+        max_tokens = group[0].payload[1]
+        counts = [len(item.payload[0]) for item in group]
+        prepared = self._prepared_rows(group, embedder)
+        if prepared is not None:
+            ids, mask = prepared
+        else:
+            texts = [t for item in group for t in item.payload[0]]
+            ids, mask = embedder.tokenize_ring(texts, max_tokens)
+        self._count_padded(embedder, ids, mask)
+        emb = embedder.embed_tokens_ring(ids, mask)
+        tokens = mask.sum(axis=1)
+
+        def finalize() -> list:
+            emb_np = np.asarray(emb)
+            out = []
+            start = 0
+            for count in counts:
+                out.append(
+                    (
+                        emb_np[start : start + count],
+                        tokens[start : start + count],
+                    )
+                )
+                start += count
+            return out
+
+        return finalize
+
+    def _dispatch_ring_vote(self, group: list, embedder):
+        """Over-length consensus items -> full-length scoring via the
+        fused ring embed + vote (``consensus_confidence_tokens_ring``).
+        One device dispatch PER item — there is no grouped ring vote
+        (long-context groups are rare and row-heavy; the per-item
+        dispatches still pipeline through the shared readiness sink) —
+        with the dense (truncating) fallback on the CPU twin."""
+        if not getattr(embedder, "ring_available", lambda: False)():
+            return self._dispatch_consensus(group, embedder)
+        staged = []
+        for item in group:
+            texts, temperature = item.payload
+            fut = item.prepared
+            if embedder is self.embedder and fut is not None:
+                ids, mask = fut.result()  # re-raises tokenizer errors
+            else:
+                ids, mask = embedder.tokenize_ring(texts)
+            self._pad_real_tokens += int(mask.sum())
+            self._pad_slot_tokens += int(ids.size)
+            conf = embedder.consensus_confidence_tokens_ring(
+                ids, mask, temperature
+            )
+            staged.append((conf, int(mask.sum())))
+
+        def finalize() -> list:
+            return [(np.asarray(conf), tok) for conf, tok in staged]
 
         return finalize
 

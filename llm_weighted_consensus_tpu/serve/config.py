@@ -42,9 +42,18 @@ TPU additions:
   this mode supersedes (mutually exclusive with it and with ``MESH_SP``).
   Off by default: unset leaves the single-device path untouched.
 * ``MESH_SHAPE`` — the mesh layout for ``MESH_ENABLED`` as ``DPxTP``
-  (e.g. ``4x2`` = batches split 4-way, encoder params 2-way).  Unset
+  (e.g. ``4x2`` = batches split 4-way, encoder params 2-way) or
+  ``DPxTPxSP`` (e.g. ``2x2x2`` adds a 2-way sequence-parallel axis:
+  over-length score/embed requests dispatch as ring attention over
+  ``sp`` instead of truncating, parallel/ring.py).  Without the sp
+  axis the serving path is byte-identical to the 2-axis form.  Unset
   with ``MESH_ENABLED=1`` uses every local device on ``dp`` (tp=1);
   setting it without ``MESH_ENABLED`` is an error.
+* ``LONG_CONTEXT_WARMUP`` — ring AOT buckets as ``NxS`` specs (e.g.
+  ``4x4096,1x8192``): with an sp-bearing ``MESH_SHAPE`` these
+  long-context consensus/embed shapes compile at startup, so the first
+  over-length request pays no trace.  N=1 warms the plain embed path.
+  Requires ``MESH_SHAPE=DPxTPxSP``; empty = ring shapes compile lazily.
 * ``MULTIHOST`` — set to 1 on each host of a multi-host slice to call
   ``jax.distributed.initialize`` before mesh construction (parallel/dist.py).
 * ``COMPILE_CACHE_DIR`` — persistent XLA compilation cache: jit
@@ -465,23 +474,58 @@ def _parse_warmup_r(raw) -> list:
     return buckets
 
 
+def _parse_long_context_warmup(raw) -> list:
+    """"4x4096,1x8192" -> [(4, 4096), (1, 8192)]: ring AOT buckets for
+    ``MESH_SHAPE=DPxTPxSP`` serving (N candidates x S tokens; N=1 warms
+    the plain long-document embed path, so the floor is 1 where
+    ``WARMUP``'s is 2).  Same loud-failure contract as
+    ``_parse_warmup``."""
+    if not raw:
+        return []
+    out = []
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            n_s = part.split("x")
+            n, s = int(n_s[0]), int(n_s[1])
+            if len(n_s) != 2 or n < 1 or s < 1:
+                raise ValueError
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"LONG_CONTEXT_WARMUP spec {part!r}: expected NxS with "
+                "N >= 1 candidates and S >= 1 tokens (e.g. 4x4096)"
+            ) from None
+        out.append((n, s))
+    return out
+
+
 def _parse_mesh_shape(raw) -> Optional[tuple]:
-    """"4x2" -> (4, 2).  Raises on malformed values, same loud-failure
-    contract as ``_parse_warmup``: a silently dropped mesh shape would
-    serve single-device while claiming a mesh."""
+    """"4x2" -> (4, 2); "2x2x2" -> (2, 2, 2).  The optional third axis
+    is sequence parallelism (ring attention, parallel/ring.py) — the
+    2-form stays the exact pre-sp serving path.  Raises on malformed
+    values, same loud-failure contract as ``_parse_warmup``: a silently
+    dropped mesh shape would serve single-device while claiming a
+    mesh."""
     if not raw:
         return None
     try:
-        dp_tp = str(raw).strip().split("x")
-        dp, tp = int(dp_tp[0]), int(dp_tp[1])
-        if len(dp_tp) != 2 or dp < 1 or tp < 1:
+        parts = [int(p) for p in str(raw).strip().split("x")]
+        if len(parts) not in (2, 3) or any(p < 1 for p in parts):
             raise ValueError
     except (ValueError, IndexError):
         raise ValueError(
-            f"MESH_SHAPE {raw!r}: expected DPxTP with positive axes "
-            "(e.g. 4x2 = batches split 4-way, encoder params 2-way)"
+            f"MESH_SHAPE {raw!r}: expected DPxTP or DPxTPxSP with "
+            "positive axes (e.g. 4x2 = batches split 4-way, encoder "
+            "params 2-way; 2x2x2 adds 2-way sequence parallelism for "
+            "long-context serving)"
         ) from None
-    return (dp, tp)
+    if len(parts) == 3 and parts[2] == 1:
+        # sp=1 is exactly the 2-axis mesh; normalize so downstream code
+        # (and the byte-identical no-sp contract) sees one canonical form
+        parts = parts[:2]
+    return tuple(parts)
 
 
 def _non_negative_int(env: dict, name: str, default: int) -> int:
@@ -553,7 +597,9 @@ class Config:
     # first-class mesh serving (parallel/sharding.py shard_embedder_mesh):
     # off by default = the single-device path bit-for-bit
     mesh_enabled: bool = False
-    mesh_shape: Optional[tuple] = None  # (dp, tp) parsed from "DPxTP"
+    mesh_shape: Optional[tuple] = None  # (dp, tp[, sp]) from "DPxTP[xSP]"
+    # ring AOT buckets (NxS) warmed when MESH_SHAPE carries an sp axis
+    long_context_warmup: list = field(default_factory=list)
     compile_cache_dir: Optional[str] = None
     profile_dir: Optional[str] = None
     archive_path: Optional[str] = None
@@ -735,6 +781,9 @@ class Config:
             mesh_sp=int(env["MESH_SP"]) if env.get("MESH_SP") else None,
             mesh_enabled=env_truthy(env.get("MESH_ENABLED", "0")),
             mesh_shape=_parse_mesh_shape(env.get("MESH_SHAPE")),
+            long_context_warmup=_parse_long_context_warmup(
+                env.get("LONG_CONTEXT_WARMUP")
+            ),
             compile_cache_dir=env.get("COMPILE_CACHE_DIR"),
             profile_dir=env.get("PROFILE_DIR"),
             archive_path=env.get("ARCHIVE_PATH"),
@@ -917,6 +966,15 @@ class Config:
                 "MESH_ENABLED is mutually exclusive with the legacy "
                 "MESH_DP/MESH_TP/MESH_SP hooks: the first-class mesh mode "
                 "supersedes them (use MESH_SHAPE=DPxTP)"
+            )
+        if config.long_context_warmup and (
+            config.mesh_shape is None or len(config.mesh_shape) != 3
+        ):
+            raise ValueError(
+                "LONG_CONTEXT_WARMUP is set but MESH_SHAPE carries no sp "
+                "axis: ring buckets only compile on a sequence-parallel "
+                "mesh (set MESH_SHAPE=DPxTPxSP, e.g. 2x2x2, or unset "
+                "LONG_CONTEXT_WARMUP)"
             )
         if config.mesh_fault_enabled and not config.mesh_enabled:
             raise ValueError(

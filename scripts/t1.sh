@@ -15,6 +15,12 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_serving
 # recovery, the seeded acceptance drill) must fail tier-1 by name even
 # if collection of the glob above breaks.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_meshfault.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_mf=$?; [ $rc -eq 0 ] && rc=$rc_mf; \
+# long-context serving tests, explicitly: the sequence-parallel ring
+# path (ring-vs-dense parity across sp and quantization, the sp-bearing
+# downsize drill, the MESH_SHAPE-without-sp byte-identical contract,
+# the over-length batcher e2e) must fail tier-1 by name even if
+# collection of the glob above breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_longcontext.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_lc=$?; [ $rc -eq 0 ] && rc=$rc_lc; \
 # consensus-quality tests, explicitly: scorecards/kappa/drift, the outcome
 # ledger, the JUDGE_BIAS_PLAN drill, and the ledger→training round trip
 # must fail tier-1 by name even if collection of the glob above breaks.

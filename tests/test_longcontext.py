@@ -1,0 +1,291 @@
+"""Long-context serving (ISSUE PR 14): sequence parallelism wired into
+the first-class mesh serving path end to end.
+
+What this pins, on the tier-1 8-virtual-device CPU mesh:
+
+* ring-vs-dense parity through the SERVING entry points
+  (``embed_tokens_ring`` / ``consensus_confidence_tokens_ring`` on a
+  ``shard_embedder_mesh``-sharded embedder) at sp=2/4/8 for the dense,
+  int8-pallas and int4-pallas weight paths — the ring rotation changes
+  the layout, never the math, regardless of quantization;
+* the meshfault downsize drill on an sp-bearing shape — dp halves, sp
+  survives every rung, the warmed rung serves ring traffic with zero
+  new jit specializations and answers identical to the full shape;
+* ``MESH_SHAPE`` without an sp axis is byte-identical to the pre-sp
+  serving path (the opt-in contract): same parse, same mesh axes, same
+  AOT key namespace, zero ring state on the embedder;
+* the e2e acceptance: a scored/embedded request at a sequence length
+  the dense window cannot serve full-length rides the ring route
+  through the DeviceBatcher and matches a full-window dense reference.
+
+Jit caches are process-global and SHARED across embedder instances, so
+every zero-growth assertion is a delta whose reference dispatches all
+run BEFORE the first snapshot (the test_aot.py discipline).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from llm_weighted_consensus_tpu.models import configs
+from llm_weighted_consensus_tpu.models.embedder import TpuEmbedder
+from llm_weighted_consensus_tpu.parallel.mesh import make_mesh
+from llm_weighted_consensus_tpu.parallel.sharding import shard_embedder_mesh
+from llm_weighted_consensus_tpu.resilience.meshfault import MeshFaultManager
+from llm_weighted_consensus_tpu.serve.batcher import DeviceBatcher
+from llm_weighted_consensus_tpu.serve.config import _parse_mesh_shape
+from llm_weighted_consensus_tpu.serve.metrics import Metrics
+
+TINY = configs.TEST_TINY
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def make_embedder(**kw):
+    kw.setdefault("config", TINY)
+    kw.setdefault("max_tokens", 64)
+    return TpuEmbedder("test-tiny", seed=3, **kw)
+
+
+def ring_embedder(sp, dp=None, tp=1, **kw):
+    emb = make_embedder(**kw)
+    dp = dp if dp is not None else 8 // (tp * sp)
+    shard_embedder_mesh(emb, make_mesh(dp=dp, tp=tp, sp=sp))
+    return emb
+
+
+def token_batch(n, s, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(3, TINY.vocab_size, (n, s)).astype(np.int32)
+    mask = np.ones((n, s), np.int32)
+    mask[-1, s - s // 4 :] = 0  # one ragged row: pads cross sp shards
+    return ids, mask
+
+
+# -- ring-vs-dense parity across sp and quantization --------------------------
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+@pytest.mark.parametrize("quantize", ["none", "int8-pallas", "int4-pallas"])
+def test_ring_serving_parity_across_sp_and_quant(sp, quantize):
+    """The serving-path parity matrix: the sp-sharded ring dispatch
+    (embed + fused vote) answers exactly like the single-device dense
+    forward under the SAME weight quantization.  int8/int4 run the
+    interpret-mode Pallas kernels inside the shard_map — the W8A8/W4A8
+    epilogue must be invariant to where the sequence axis lives."""
+    ref = make_embedder(quantize=quantize)
+    emb = ring_embedder(sp, quantize=quantize)
+    assert emb.ring_available()
+    assert emb.mesh_sp == sp
+    # test-tiny usable window is 64; every sp here divides it exactly
+    assert emb.ring_max_tokens == 64
+
+    ids, mask = token_batch(2, 16, seed=sp)
+    np.testing.assert_allclose(
+        emb.embed_tokens_ring(ids, mask),
+        ref.embed_tokens(ids, mask),
+        atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        emb.consensus_confidence_tokens_ring(ids, mask, temperature=1.0),
+        np.asarray(ref.consensus_confidence_tokens(ids, mask, 1.0)),
+        atol=2e-4,
+    )
+
+
+def test_ring_dispatch_at_full_window_beyond_dense_cap():
+    """A sequence at the full position window dispatches through the
+    ring even when the embedder's dense cap is shorter — the shape the
+    dense bucket table would never serve."""
+    ref = make_embedder(max_tokens=64)
+    emb = ring_embedder(sp=4, max_tokens=32)
+    assert emb.max_tokens == 32 and emb.ring_max_tokens == 64
+    ids, mask = token_batch(2, 64, seed=11)
+    np.testing.assert_allclose(
+        emb.embed_tokens_ring(ids, mask),
+        ref.embed_tokens(ids, mask),
+        atol=2e-4,
+    )
+
+
+# -- meshfault: downsize drill on an sp-bearing shape -------------------------
+
+
+def test_meshfault_downsize_preserves_sp_and_serves_warmed():
+    """dp 4 -> 2 -> 1 with sp=2 riding along: every rung's mesh keeps
+    the sp axis, the warmed rung serves the ring bucket with zero new
+    specializations, and the degraded answers match the full shape."""
+    ref = make_embedder()
+    emb = ring_embedder(sp=2, dp=4)
+    mgr = MeshFaultManager(emb, shape=(4, 1))
+    assert mgr.build_ladder() == [(4, 1), (2, 1), (1, 1)]
+
+    n, s = 2, 32
+    ids, mask = token_batch(n, s, seed=21)
+    want_conf = np.asarray(ref.consensus_confidence_tokens(ids, mask, 1.0))
+    want_emb = ref.embed_tokens(ids, mask)
+
+    mgr.warm_ladder([], ring_buckets=[(n, s)])
+    full_conf = np.asarray(
+        emb.consensus_confidence_tokens_ring(ids, mask, 1.0)
+    )
+    before = emb.jit_stats()["specializations"]
+
+    assert mgr.downsize() is True
+    snap = mgr.snapshot()
+    assert snap["current_shape"] == [2, 1]
+    assert snap["sp"] == 2
+    assert dict(emb.mesh.shape) == {"dp": 2, "tp": 1, "sp": 2}
+    assert emb.ring_available()
+
+    got_conf = np.asarray(
+        emb.consensus_confidence_tokens_ring(ids, mask, 1.0)
+    )
+    got_emb = emb.embed_tokens_ring(ids, mask)
+    np.testing.assert_allclose(got_conf, full_conf, atol=1e-5)
+    np.testing.assert_allclose(got_conf, want_conf, atol=2e-4)
+    np.testing.assert_allclose(got_emb, want_emb, atol=2e-4)
+    assert emb.jit_stats()["specializations"] == before
+
+    # the last rung (dp=1) still carries sp and still serves
+    assert mgr.downsize() is True
+    assert dict(emb.mesh.shape) == {"dp": 1, "tp": 1, "sp": 2}
+    np.testing.assert_allclose(
+        emb.embed_tokens_ring(ids, mask), want_emb, atol=2e-4
+    )
+    assert emb.jit_stats()["specializations"] == before
+
+
+# -- MESH_SHAPE without sp: byte-identical pre-sp path ------------------------
+
+
+def test_mesh_shape_without_sp_is_the_exact_pre_sp_path():
+    """The opt-in contract: no sp in MESH_SHAPE (or sp=1) must leave
+    the serving path untouched — same parsed shape, same 2-axis mesh,
+    same AOT key namespace, no ring state anywhere."""
+    assert _parse_mesh_shape("4x2") == (4, 2)
+    # sp=1 normalizes away at parse time AND at mesh-construction time
+    assert _parse_mesh_shape("4x2x1") == (4, 2)
+    assert make_mesh(dp=4, tp=2, sp=1).axis_names == ("dp", "tp")
+
+    plain = make_embedder()
+    shard_embedder_mesh(plain, make_mesh(dp=4, tp=2))
+    assert plain.mesh_sp == 1
+    assert not plain.ring_available()
+    assert plain.ring_sharding is None
+    assert plain._ring_config is None
+
+    normalized = make_embedder()
+    shard_embedder_mesh(normalized, make_mesh(dp=4, tp=2, sp=1))
+    plain.aot_warmup([(4, 16)])
+    normalized.aot_warmup([(4, 16)])
+    assert set(plain._aot) == set(normalized._aot)
+    assert all(key[0] == "mesh" and key[1:3] == (4, 2) for key in plain._aot)
+    # a ring bucket request on a no-sp mesh is ignored, not mis-keyed
+    keys = set(plain._aot)
+    plain.aot_warmup([], ring_buckets=[(2, 32)])
+    assert set(plain._aot) == keys
+    with pytest.raises(RuntimeError, match="sp axis"):
+        plain.embed_tokens_ring(*token_batch(2, 32))
+
+
+def test_long_context_warmup_requires_sp_mesh_shape():
+    from llm_weighted_consensus_tpu.serve.config import Config
+
+    with pytest.raises(ValueError, match="sp"):
+        Config.from_env(
+            {
+                "MESH_ENABLED": "1",
+                "MESH_SHAPE": "4x2",
+                "LONG_CONTEXT_WARMUP": "2x64",
+            }
+        )
+    config = Config.from_env(
+        {
+            "MESH_ENABLED": "1",
+            "MESH_SHAPE": "2x2x2",
+            "LONG_CONTEXT_WARMUP": "2x64,1x32",
+        }
+    )
+    assert config.mesh_shape == (2, 2, 2)
+    assert config.long_context_warmup == [(2, 64), (1, 32)]
+
+
+# -- e2e: the batcher serves what the dense window cannot ---------------------
+
+
+def test_batcher_routes_over_length_to_ring_full_length():
+    """The PR's acceptance shape: a scored request LONGER than the
+    dense token window succeeds through the batcher via the ring route
+    and matches a full-window dense reference — where the dense path
+    would have truncated half the evidence away."""
+    # dense window 32, ring window 64 (the full test-tiny position table)
+    emb = ring_embedder(sp=2, dp=2, max_tokens=32)
+    full_ref = make_embedder(max_tokens=64)
+    short = ["compact candidate answer", "another short one"]
+    long_texts = [
+        "evidence " * 40 + "verdict alpha",
+        "evidence " * 40 + "verdict beta",
+    ]
+    # the long texts genuinely exceed the dense window...
+    ids, mask = full_ref.tokenize(long_texts)
+    assert int(mask.sum(axis=1).max()) > emb.max_tokens
+    # ...but fit the ring window full-length
+    assert int(mask.sum(axis=1).max()) <= emb.ring_max_tokens
+
+    metrics = Metrics()
+    batcher = DeviceBatcher(emb, metrics, window_ms=5.0)
+
+    async def run():
+        return await asyncio.gather(
+            batcher.consensus(long_texts, 1.0),
+            batcher.embed(long_texts),
+            batcher.consensus(short, 1.0),
+        )
+
+    (conf, conf_tokens), (vecs, emb_tokens), (short_conf, _) = go(run())
+
+    np.testing.assert_allclose(
+        conf,
+        np.asarray(full_ref.consensus_confidence(long_texts, temperature=1.0)),
+        atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        vecs, full_ref.embed_texts(long_texts), atol=2e-4
+    )
+    # usage accounting saw the FULL length, not the truncated window
+    assert conf_tokens > 2 * emb.max_tokens
+    assert emb_tokens == conf_tokens
+    # short traffic stayed on the dense dispatch — the ring is opt-in
+    # per request, not a mode switch
+    series = metrics.snapshot()["series"]
+    assert series["device:batch:ring_vote"]["count"] == 1
+    assert series["device:batch:ring_embed"]["count"] == 1
+    assert series["device:batch:consensus"]["count"] == 1
+    ref_short = make_embedder(max_tokens=32)
+    np.testing.assert_allclose(
+        short_conf,
+        np.asarray(ref_short.consensus_confidence(short, temperature=1.0)),
+        atol=2e-4,
+    )
+
+
+def test_batcher_explicit_truncation_stays_dense():
+    """An explicit max_tokens at or under the dense window is an
+    intentional truncation request: no ring dispatch, byte-identical
+    answers to the dense path."""
+    emb = ring_embedder(sp=2, dp=2, max_tokens=32)
+    ref = make_embedder(max_tokens=32)
+    long_texts = ["evidence " * 40]
+    metrics = Metrics()
+    batcher = DeviceBatcher(emb, metrics, window_ms=5.0)
+    vecs, _ = go(batcher.embed(long_texts, max_tokens=32))
+    np.testing.assert_allclose(
+        vecs, ref.embed_texts(long_texts, max_tokens=32), atol=1e-5
+    )
+    assert "device:batch:ring_embed" not in metrics.snapshot()["series"]

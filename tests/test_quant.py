@@ -364,9 +364,14 @@ def test_quant_mode_validation_and_auto_selection():
         resolve_quantize,
     )
 
-    assert set(QUANT_MODES) == {"none", "int8", "int8-pallas", "int8-xla"}
+    assert set(QUANT_MODES) == {
+        "none", "int8", "int8-pallas", "int8-xla", "int4-pallas"
+    }
     assert impl_for("int8-pallas") == "pallas"
     assert impl_for("int8-xla") == "xla"
+    # int4 has no XLA kernel twin: the pallas impl (interpret mode off
+    # TPU) is the only W4A8 path, everywhere
+    assert impl_for("int4-pallas") == "pallas"
     # auto mode picks by backend: xla everywhere but tpu
     expect = "pallas" if jax.default_backend() == "tpu" else "xla"
     assert impl_for("int8") == expect
@@ -374,3 +379,97 @@ def test_quant_mode_validation_and_auto_selection():
         impl_for("none")
     with pytest.raises(ValueError):
         resolve_quantize(TINY, {}, "int4")
+
+
+# -- W4A8 packed-int4 weights -------------------------------------------------
+
+
+def test_quantize_weight_int4_roundtrip_error_bounded():
+    from llm_weighted_consensus_tpu.models.quant import (
+        _unpack_int4,
+        quantize_weight_int4,
+    )
+
+    rng = np.random.default_rng(12)
+    w = jnp.asarray(rng.standard_normal((64, 32)) * 0.1, jnp.float32)
+    kq, scale = quantize_weight_int4(w)
+    from llm_weighted_consensus_tpu.ops.kernels import W4A8_PACK_K
+
+    # two nibbles per byte along a K axis padded to the kernel's pack
+    # block: half the padded rows, same output channels
+    assert kq.dtype == jnp.uint8 and kq.shape == (W4A8_PACK_K // 2, 32)
+    assert scale.shape == (32,)
+    deq = np.asarray(_unpack_int4(kq, 64), np.float32) * np.asarray(scale)[None]
+    # symmetric int4 round-off: half a step of each channel's scale
+    err = np.abs(deq - np.asarray(w))
+    assert (err <= np.asarray(scale)[None, :] * 0.5 + 1e-9).all()
+
+
+def test_w4a8_kernel_matches_xla_unpack_path():
+    """The in-kernel nibble unpack vs the XLA unpack-then-int8 fallback:
+    SAME quantized math (identical int4 decode, per-token activation
+    scales, int32 accumulation), so parity is float round-off — the
+    JXA011-tolerance evidence that packing changed the storage, not the
+    answer."""
+    from llm_weighted_consensus_tpu.models.quant import (
+        dense_int4,
+        quantize_weight_int4,
+    )
+
+    rng = np.random.default_rng(13)
+    w = jnp.asarray(rng.standard_normal((48, 24)) * 0.2, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(24) * 0.1, jnp.float32)
+    kq, scale = quantize_weight_int4(w)
+    p = {"kernel_q": kq, "scale": scale, "bias": b}
+    for shape in [(8, 48), (2, 5, 48)]:
+        x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        got = np.asarray(dense_int4(x, p, impl="pallas"))
+        want = np.asarray(dense_int4(x, p, impl="xla"))
+        assert got.shape == want.shape == (*shape[:-1], 24)
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+    # the fused-gelu epilogue carries over from the W8A8 kernel
+    x = jnp.asarray(rng.standard_normal((8, 48)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(dense_int4(x, p, gelu=True, impl="pallas")),
+        np.asarray(dense_int4(x, p, gelu=True, impl="xla")),
+        atol=1e-4, rtol=1e-4,
+    )
+
+
+def test_int4_pallas_forward_tracks_full_precision():
+    """End-to-end W4A8 acceptance: int4 is coarser than int8, but the
+    l2-normalized embeddings must stay directionally faithful and the
+    consensus vote must agree on top-1."""
+    import dataclasses
+
+    from llm_weighted_consensus_tpu.models.quant import (
+        is_int4,
+        quantize_bert_params_int4,
+    )
+
+    params = bert.init_params(jax.random.PRNGKey(0), TINY)
+    qparams = quantize_bert_params_int4(params)
+    assert is_int4(qparams)
+    qcfg = dataclasses.replace(TINY, quantize="int4-pallas")
+    rng = np.random.default_rng(14)
+    ids = jnp.asarray(rng.integers(3, TINY.vocab_size, (4, 16)), jnp.int32)
+    mask = jnp.ones((4, 16), jnp.int32)
+    full = np.asarray(bert.embed(params, ids, mask, TINY))
+    fused = np.asarray(bert.embed(qparams, ids, mask, qcfg))
+    cos = (full * fused).sum(axis=1)
+    assert cos.min() > 0.95, cos
+
+    kwargs = dict(config=TINY, max_tokens=32, seed=3)
+    ref = TpuEmbedder("test-tiny", **kwargs)
+    emb = TpuEmbedder("test-tiny", quantize="int4-pallas", **kwargs)
+    assert emb.config.quantize == "int4-pallas"
+    texts = [
+        "the answer is four",
+        "the answer is four",
+        "the answer is four!",
+        "bananas and poetry 999",
+    ]
+    cf = np.asarray(ref.consensus_confidence(texts))
+    cq = np.asarray(emb.consensus_confidence(texts))
+    assert cf.argmax() == cq.argmax()
+    assert np.abs(cf - cq).max() < 0.15, (cf, cq)

@@ -11,9 +11,12 @@ entry missing its mandatory ``reason``).
 
 Flags/env: ``--no-jaxpr`` or ``ANALYSIS_SKIP_JAXPR=1`` skips the jaxpr
 audit (lint stays); ``--no-mesh`` or ``ANALYSIS_SKIP_MESH=1`` skips the
-mesh audit; ``--baseline PATH`` / ``ANALYSIS_BASELINE`` overrides the
-baseline file; ``--rules LWC001,...`` restricts lint rules; ``--json``
-emits machine-readable findings; positional paths lint specific files
+mesh audit; ``--no-concurrency`` or ``ANALYSIS_SKIP_CONCURRENCY=1``
+skips the whole-program concurrency audit (LWC014–016 — the lock-model
+registry, guarded-field, lock-order, and blocking-under-lock rules);
+``--baseline PATH`` / ``ANALYSIS_BASELINE`` overrides the baseline
+file; ``--rules LWC001,...`` restricts lint rules; ``--json`` emits
+machine-readable findings; positional paths lint specific files
 instead of the whole package.  The jaxpr audit's own knobs
 (``ANALYSIS_JAXPR_MODEL`` / ``_SPECS`` / ``_R_BUCKETS``) are documented
 in ``jaxpr_audit.py``; the mesh audit's (``ANALYSIS_MESH_MODEL`` /
@@ -57,6 +60,11 @@ def main(argv=None) -> int:
         "(ANALYSIS_SKIP_MESH=1)",
     )
     parser.add_argument(
+        "--no-concurrency", action="store_true",
+        help="skip the concurrency-discipline audit, rules LWC014-016 "
+        "(ANALYSIS_SKIP_CONCURRENCY=1)",
+    )
+    parser.add_argument(
         "--baseline", type=Path, default=None,
         help="suppression baseline (default analysis/baseline.json; "
         "ANALYSIS_BASELINE overrides)",
@@ -80,7 +88,7 @@ def main(argv=None) -> int:
             print(f"{rule.name}  {rule.summary}")
         return 0
 
-    rules = None
+    rules = list(ALL_RULES)
     if args.rules:
         try:
             rules = [RULES_BY_NAME[n.strip()] for n in args.rules.split(",")]
@@ -88,9 +96,26 @@ def main(argv=None) -> int:
             print(f"unknown rule {exc}", file=sys.stderr)
             return 2
 
+    # the concurrency trio runs as its own timed pass (bench_host.py
+    # budgets it alongside the jaxpr/mesh audits), skippable without
+    # touching the per-function lint
+    conc_names = {"LWC014", "LWC015", "LWC016"}
+    skip_conc = args.no_concurrency or bool(
+        os.environ.get("ANALYSIS_SKIP_CONCURRENCY")
+    )
+    conc_rules = [r for r in rules if r.name in conc_names]
+    base_rules = [r for r in rules if r.name not in conc_names]
+
     t0 = time.perf_counter()
-    findings = run_lint(paths=args.paths or None, rules=rules)
+    findings = run_lint(paths=args.paths or None, rules=base_rules)
     lint_s = time.perf_counter() - t0
+
+    concurrency_s = 0.0
+    if conc_rules and not skip_conc:
+        t0 = time.perf_counter()
+        findings += run_lint(paths=args.paths or None, rules=conc_rules)
+        concurrency_s = time.perf_counter() - t0
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     jaxpr_s = 0.0
     skip_jaxpr = args.no_jaxpr or bool(os.environ.get("ANALYSIS_SKIP_JAXPR"))
@@ -130,6 +155,7 @@ def main(argv=None) -> int:
                     "suppressed": [vars(f) for f in suppressed],
                     "stale_baseline": stale,
                     "lint_seconds": round(lint_s, 3),
+                    "concurrency_seconds": round(concurrency_s, 3),
                     "jaxpr_seconds": round(jaxpr_s, 3),
                     "mesh_seconds": round(mesh_s, 3),
                 }
@@ -142,6 +168,8 @@ def main(argv=None) -> int:
             f"analysis: {len(kept)} finding(s), {len(suppressed)} "
             f"baselined, lint {lint_s:.2f}s"
         )
+        if conc_rules and not skip_conc:
+            summary += f", concurrency audit {concurrency_s:.2f}s"
         if not skip_jaxpr:
             summary += f", jaxpr audit {jaxpr_s:.2f}s"
         if not skip_mesh:

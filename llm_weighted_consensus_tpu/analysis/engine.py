@@ -61,6 +61,20 @@ class FunctionInfo:
 
 
 @dataclass
+class ClassInfo:
+    """One class definition with its direct methods — the unit the
+    class-scoped concurrency rules (LWC014–016) reason over, where the
+    per-function rules reason over one body at a time."""
+
+    name: str
+    qualname: str
+    node: ast.ClassDef
+    # direct methods only (nested defs inside a method are separate
+    # FunctionInfo entries in ``functions()``, per the engine contract)
+    methods: List[FunctionInfo] = field(default_factory=list)
+
+
+@dataclass
 class ParsedModule:
     path: Path
     rel: str  # repo-relative posix path
@@ -69,6 +83,7 @@ class ParsedModule:
     _functions: Optional[List[FunctionInfo]] = field(
         default=None, repr=False
     )
+    _classes: Optional[List[ClassInfo]] = field(default=None, repr=False)
 
     def functions(self) -> List[FunctionInfo]:
         """Every function/method in the module (nested ones included,
@@ -76,6 +91,13 @@ class ParsedModule:
         if self._functions is None:
             self._functions = list(_collect_functions(self.tree))
         return self._functions
+
+    def classes(self) -> List[ClassInfo]:
+        """Every class in the module (nested ones included), each with
+        its direct methods as FunctionInfo entries."""
+        if self._classes is None:
+            self._classes = list(_collect_classes(self.tree))
+        return self._classes
 
 
 def _collect_functions(
@@ -99,6 +121,39 @@ def _collect_functions(
                 yield from walk(child, prefix, class_name)
 
     yield from walk(tree, "", "")
+
+
+def _collect_classes(tree: ast.Module) -> Iterator[ClassInfo]:
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                qual = f"{prefix}{child.name}" if prefix else child.name
+                methods = [
+                    FunctionInfo(
+                        qualname=f"{qual}.{m.name}",
+                        node=m,
+                        is_async=isinstance(m, ast.AsyncFunctionDef),
+                        class_name=child.name,
+                    )
+                    for m in child.body
+                    if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                ]
+                yield ClassInfo(
+                    name=child.name,
+                    qualname=qual,
+                    node=child,
+                    methods=methods,
+                )
+                yield from walk(child, f"{qual}.")
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                qual = f"{prefix}{child.name}" if prefix else child.name
+                yield from walk(child, f"{qual}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
 
 
 def body_nodes(func: ast.AST) -> Iterator[ast.AST]:

@@ -42,6 +42,9 @@ from . import (  # noqa: E402
     lwc011_config_readme_drift,
     lwc012_prom_family_registry,
     lwc013_blocking_readiness,
+    lwc014_guarded_field,
+    lwc015_lock_order,
+    lwc016_blocking_under_lock,
 )
 
 ALL_RULES: Tuple[Rule, ...] = (
@@ -58,6 +61,9 @@ ALL_RULES: Tuple[Rule, ...] = (
     lwc011_config_readme_drift.RULE,
     lwc012_prom_family_registry.RULE,
     lwc013_blocking_readiness.RULE,
+    lwc014_guarded_field.RULE,
+    lwc015_lock_order.RULE,
+    lwc016_blocking_under_lock.RULE,
 )
 
 RULES_BY_NAME = {rule.name: rule for rule in ALL_RULES}

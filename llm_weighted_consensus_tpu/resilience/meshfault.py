@@ -589,17 +589,23 @@ class MeshFaultManager:
                     self._probe_failures += 1
                     self._consecutive_probe_failures += 1
                 return False
-        elif self.probe_fn is None and not self._warned_blind_upsize:
-            self._warned_blind_upsize = True
-            import logging
+        elif self.probe_fn is None:
+            # test-and-set under the lock: two prober threads racing the
+            # unlocked flag would both pass the check and double-warn
+            with self._lock:
+                warn = not self._warned_blind_upsize
+                self._warned_blind_upsize = True
+            if warn:
+                import logging
 
-            logging.getLogger("lwc.resilience").warning(
-                "mesh fault recovery has no probe_fn and no "
-                "DEVICE_FAULT_PLAN: upsizing to the full mesh without "
-                "validating it — a still-dead device will fault the next "
-                "dispatch and downsize again (attach probe_fn, as "
-                "serve/__main__.py does, to validate before upsizing)"
-            )
+                logging.getLogger("lwc.resilience").warning(
+                    "mesh fault recovery has no probe_fn and no "
+                    "DEVICE_FAULT_PLAN: upsizing to the full mesh "
+                    "without validating it — a still-dead device will "
+                    "fault the next dispatch and downsize again (attach "
+                    "probe_fn, as serve/__main__.py does, to validate "
+                    "before upsizing)"
+                )
         with self._shape_gate.exclusive():
             with self._lock:
                 prev_index = self._rung_index

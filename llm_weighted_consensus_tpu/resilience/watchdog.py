@@ -82,6 +82,7 @@ class DeviceWatchdog:
 
     # -- the check (monitor thread, or tests directly) ------------------------
 
+    # caller-holds-lock: DeviceWatchdog._lock (only end/check call this, inside their with-lock blocks)
     def _overdue_locked(self):
         now = self.clock()
         worst = None

@@ -1042,6 +1042,42 @@ def build_service(
         watchdog.on_recover = _on_recover
         watchdog.start()
 
+    # LOCK_WITNESS=1: runtime lockdep (analysis/witness.py) — wrap the
+    # registered threading primitives so real acquisition order is
+    # validated against the declared DAG (analysis/concurrency_model.py)
+    # while the server runs; the snapshot rides /metrics and the drain
+    # path prints the summary the soak drill asserts on
+    witness = None
+    if config.lock_witness:
+        from ..analysis.witness import LockWitness
+        from ..obs import phases as _obs_phases
+        from ..obs import quality as _obs_quality
+
+        witness = LockWitness()
+        _obs_phases._AGG._lock = witness.wrap_lock(
+            "PhaseAggregator._lock", _obs_phases._AGG._lock
+        )
+        _obs_quality._AGG._lock = witness.wrap_lock(
+            "QualityAggregator._lock", _obs_quality._AGG._lock
+        )
+        if watchdog is not None:
+            watchdog._lock = witness.wrap_lock(
+                "DeviceWatchdog._lock", watchdog._lock
+            )
+        if batcher is not None:
+            batcher._stats_lock = witness.wrap_lock(
+                "DeviceBatcher._stats_lock", batcher._stats_lock
+            )
+        if meshfault is not None:
+            meshfault._lock = witness.wrap_lock(
+                "MeshFaultManager._lock", meshfault._lock
+            )
+            witness.wrap_gate(meshfault._shape_gate)
+        pool = getattr(embedder, "staging_pool", None)
+        if pool is not None:
+            pool._lock = witness.wrap_lock("StagingPool._lock", pool._lock)
+        metrics.register_provider("lock_witness", witness.snapshot)
+
     # admission gate: always present (with every knob 0 it never sheds,
     # it only tracks in-flight work for the drain path); device-
     # dependent endpoints additionally shed while the watchdog holds
@@ -1227,6 +1263,13 @@ def build_service(
             watchdog.stop()
 
         app.on_cleanup.append(_stop_watchdog)
+    if witness is not None:
+        # the soak drill greps this line after SIGTERM: a clean run
+        # reports its real acquisition evidence on the way out
+        async def _report_witness(app):
+            print(witness.summary_line(), flush=True)
+
+        app.on_cleanup.append(_report_witness)
     if (
         meshfault is not None
         and config.mesh_fault_probe_millis > 0

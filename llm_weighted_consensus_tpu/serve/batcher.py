@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -162,7 +163,13 @@ class DeviceBatcher:
         self.prefix_dedup = bool(prefix_dedup)
         self.prefix_dedup_min_chars = max(1, int(prefix_dedup_min_chars))
         # packing efficiency accounting (satellite: /metrics): real vs
-        # dispatched token slots per path, dedup hits, bucket occupancy
+        # dispatched token slots per path, dedup hits, bucket occupancy.
+        # The stats lock exists because these counters mutate on the
+        # dispatch executor (pipeline_depth >= 2 workers) while
+        # utilization() reads them on the event loop: += on a plain int
+        # is read-modify-write, and two workers interleaving it drop
+        # increments (registered in analysis/concurrency_model.py)
+        self._stats_lock = threading.Lock()
         self._pack_real_tokens = 0
         self._pack_slot_tokens = 0
         self._pad_real_tokens = 0
@@ -535,6 +542,19 @@ class DeviceBatcher:
         for start in self._inflight.values():
             busy += now - max(start, lo)
         span = max(min(window_sec, now - self._started), 1e-9)
+        # consistent counter snapshot: the dispatch workers mutate these
+        # under the same lock; the staging-pool stats() call below stays
+        # OUTSIDE it (the pool has its own lock — no nesting, no edge)
+        with self._stats_lock:
+            pack_real = self._pack_real_tokens
+            pack_slot = self._pack_slot_tokens
+            pad_real = self._pad_real_tokens
+            pad_slot = self._pad_slot_tokens
+            dedup_hits = self.prefix_dedup_hits
+            dedup_saved = self.prefix_dedup_tokens_saved
+            pack_fallback = self.packed_fallback_items
+            occupancy = dict(self._packed_occupancy)
+            fallback_dispatches = self.fallback_dispatches
         return {
             "queue_depth": len(self._pending),
             "busy_fraction": round(min(busy / span, 1.0), 4),
@@ -562,37 +582,30 @@ class DeviceBatcher:
             "shed_redispatch_limit": self.shed_redispatch_limit,
             "cancelled_items": self.cancelled_items,
             "fallback_active": self._use_fallback,
-            "fallback_dispatches": self.fallback_dispatches,
+            "fallback_dispatches": fallback_dispatches,
             # packing-efficiency counters (ISSUE 7): real tokens actually
             # embedded vs device slots dispatched, per path — the padding
             # waste the packed layout exists to reclaim
             "packing": {
                 "enabled": self.packing,
-                "real_tokens": self._pack_real_tokens,
-                "slot_tokens": self._pack_slot_tokens,
-                "padding_waste": round(
-                    1.0 - self._pack_real_tokens / self._pack_slot_tokens,
-                    4,
-                )
-                if self._pack_slot_tokens
+                "real_tokens": pack_real,
+                "slot_tokens": pack_slot,
+                "padding_waste": round(1.0 - pack_real / pack_slot, 4)
+                if pack_slot
                 else 0.0,
-                "prefix_dedup_hits": self.prefix_dedup_hits,
-                "prefix_dedup_tokens_saved": self.prefix_dedup_tokens_saved,
-                "fallback_items": self.packed_fallback_items,
+                "prefix_dedup_hits": dedup_hits,
+                "prefix_dedup_tokens_saved": dedup_saved,
+                "fallback_items": pack_fallback,
                 # packed row-bucket B -> device calls at that bucket
                 "bucket_occupancy": {
-                    str(b): c
-                    for b, c in sorted(self._packed_occupancy.items())
+                    str(b): c for b, c in sorted(occupancy.items())
                 },
             },
             "padded": {
-                "real_tokens": self._pad_real_tokens,
-                "slot_tokens": self._pad_slot_tokens,
-                "padding_waste": round(
-                    1.0 - self._pad_real_tokens / self._pad_slot_tokens,
-                    4,
-                )
-                if self._pad_slot_tokens
+                "real_tokens": pad_real,
+                "slot_tokens": pad_slot,
+                "padding_waste": round(1.0 - pad_real / pad_slot, 4)
+                if pad_slot
                 else 0.0,
             },
         }
@@ -1103,7 +1116,8 @@ class DeviceBatcher:
         else:
             fn = getattr(self, "_dispatch_" + group[0].kind)
         if self._use_fallback and self.fallback_embedder is not None:
-            self.fallback_dispatches += 1
+            with self._stats_lock:
+                self.fallback_dispatches += 1
             if self.fallback_context is not None:
                 # jax.default_device scope: the fallback's computations
                 # must stage on the CPU, never queue behind the wedged
@@ -1258,8 +1272,9 @@ class DeviceBatcher:
                 ids, mask = prepared
             else:
                 ids, mask = embedder.tokenize(texts0)
-            self._pad_real_tokens += int(mask.sum())
-            self._pad_slot_tokens += int(ids.size)
+            with self._stats_lock:
+                self._pad_real_tokens += int(mask.sum())
+                self._pad_slot_tokens += int(ids.size)
             conf = embedder.consensus_confidence_tokens(
                 ids, mask, temperature
             )
@@ -1278,8 +1293,9 @@ class DeviceBatcher:
         from ..utils import next_pow2
 
         # the grouped dispatch pads the request dim to its pow2 bucket
-        self._pad_real_tokens += int(mask.sum())
-        self._pad_slot_tokens += int(next_pow2(r) * n * ids.shape[1])
+        with self._stats_lock:
+            self._pad_real_tokens += int(mask.sum())
+            self._pad_slot_tokens += int(next_pow2(r) * n * ids.shape[1])
         conf = embedder.consensus_confidence_tokens_many(
             ids.reshape(r, n, -1), mask.reshape(r, n, -1), temperature
         )
@@ -1346,8 +1362,9 @@ class DeviceBatcher:
                 ids, mask = fut.result()  # re-raises tokenizer errors
             else:
                 ids, mask = embedder.tokenize_ring(texts)
-            self._pad_real_tokens += int(mask.sum())
-            self._pad_slot_tokens += int(ids.size)
+            with self._stats_lock:
+                self._pad_real_tokens += int(mask.sum())
+                self._pad_slot_tokens += int(ids.size)
             conf = embedder.consensus_confidence_tokens_ring(
                 ids, mask, temperature
             )
@@ -1361,7 +1378,6 @@ class DeviceBatcher:
     def _count_padded(self, embedder, ids, mask) -> None:
         """Padded-path efficiency accounting for an embed dispatch: real
         tokens vs the row-bucketed slot count ``embed_tokens`` pads to."""
-        self._pad_real_tokens += int(mask.sum())
         try:
             from ..models.embedder import _bucket
 
@@ -1372,7 +1388,9 @@ class DeviceBatcher:
             pad_b += (-pad_b) % getattr(embedder, "batch_multiple", 1)
         except Exception:
             pad_b = ids.shape[0]
-        self._pad_slot_tokens += int(pad_b * ids.shape[1])
+        with self._stats_lock:
+            self._pad_real_tokens += int(mask.sum())
+            self._pad_slot_tokens += int(pad_b * ids.shape[1])
 
     # -- packed (continuous-batching) dispatch --------------------------------
 
@@ -1430,7 +1448,8 @@ class DeviceBatcher:
         fallback_staged: dict = {}
         for i, plan in enumerate(plans):
             if plan[0] == "fallback":
-                self.packed_fallback_items += 1
+                with self._stats_lock:
+                    self.packed_fallback_items += 1
                 fallback_staged[i] = self._packed_item_fallback(
                     group[i], embedder
                 )
@@ -1450,12 +1469,13 @@ class DeviceBatcher:
                     call.ids, call.segment_ids, call.positions,
                     call.seg_starts,
                 )
-                self._pack_real_tokens += call.real_tokens
-                self._pack_slot_tokens += call.slot_tokens
                 b = call.ids.shape[0]
-                self._packed_occupancy[b] = (
-                    self._packed_occupancy.get(b, 0) + 1
-                )
+                with self._stats_lock:
+                    self._pack_real_tokens += call.real_tokens
+                    self._pack_slot_tokens += call.slot_tokens
+                    self._packed_occupancy[b] = (
+                        self._packed_occupancy.get(b, 0) + 1
+                    )
                 call_outs.append((call, out))
         _phases.observe_phase("pack_plan", plan_ms)
         share_plan = plan_ms / len(group)
@@ -1506,8 +1526,9 @@ class DeviceBatcher:
         segments.extend(rows)
         if stats is not None:
             _, hits, saved = stats
-            self.prefix_dedup_hits += hits
-            self.prefix_dedup_tokens_saved += saved
+            with self._stats_lock:
+                self.prefix_dedup_hits += hits
+                self.prefix_dedup_tokens_saved += saved
         return self._rebase_plan(plan, base)
 
     def _plan_packed_payload(self, kind, payload, embedder):
@@ -1642,8 +1663,9 @@ class DeviceBatcher:
             return (emb, mask.sum(axis=1))
         texts, temperature = item.payload
         ids, mask = embedder.tokenize(texts)
-        self._pad_real_tokens += int(mask.sum())
-        self._pad_slot_tokens += int(ids.size)
+        with self._stats_lock:
+            self._pad_real_tokens += int(mask.sum())
+            self._pad_slot_tokens += int(ids.size)
         conf = embedder.consensus_confidence_tokens(ids, mask, temperature)
         return (conf, int(mask.sum()))
 

@@ -694,6 +694,11 @@ class Config:
     # deterministic device-fault injection spec (DeviceFaultPlan.parse);
     # None = off (chaos runs and tier-1 drills only)
     device_fault_plan: Optional[str] = None
+    # runtime lockdep (analysis/witness.py): wrap the registered
+    # threading primitives and validate real acquisition order against
+    # the declared DAG; off by default — intended for chaos/soak drills
+    # (~1 dict update per lock acquisition when on)
+    lock_witness: bool = False
     # request tracing (obs/): head-sample rate, forced-on flag (capture
     # only degraded/shed/error at rate 0), ring capacity, JSONL dir.
     # trace_sink() returns None when nothing enables tracing, keeping
@@ -882,6 +887,7 @@ class Config:
             ),
             mesh_fault_probe_millis=get_f("MESH_FAULT_PROBE_MILLIS", 0),
             device_fault_plan=env.get("DEVICE_FAULT_PLAN"),
+            lock_witness=env_truthy(env.get("LOCK_WITNESS", "0")),
             trace_sample_rate=get_f("TRACE_SAMPLE_RATE", 0),
             trace_enabled=env_truthy(env.get("TRACE_ENABLED", "0")),
             trace_ring=max(1, int(env.get("TRACE_RING", 256))),

@@ -48,6 +48,7 @@ KNOWN_SECTIONS = (
     "roofline",
     "quality",
     "ledger",
+    "lock_witness",
 )
 
 # Every Prometheus family the text exposition may emit.  Same contract

@@ -18,4 +18,12 @@ fi
 
 env JAX_PLATFORMS=cpu python -m llm_weighted_consensus_tpu.analysis "$@" \
   || rc=$?
+
+# concurrency-discipline audit, explicitly by name: even when the main
+# invocation above is scoped down (file args, --no-concurrency, or a
+# host-level ANALYSIS_SKIP_CONCURRENCY), the lock-model registry and
+# LWC014-016 still gate the whole package before lint.sh reports green.
+env JAX_PLATFORMS=cpu ANALYSIS_SKIP_CONCURRENCY= \
+  python -m llm_weighted_consensus_tpu.analysis \
+  --rules LWC014,LWC015,LWC016 --no-jaxpr --no-mesh || rc=$?
 exit $rc

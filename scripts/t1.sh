@@ -38,4 +38,10 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_perfobs.py -
 # hosts where the 8-virtual-device respawn can't run; the pytest
 # invocation above is unchanged either way.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m llm_weighted_consensus_tpu.analysis --no-mesh; rc_an=$?; [ $rc -eq 0 ] && rc=$rc_an; \
+# concurrency audit, explicitly by name: the lock-model registry and the
+# whole-program LWC014-016 rules (guarded fields cross-thread, the
+# lock-order DAG, blocking under a held lock) gate tier-1 even on hosts
+# that exported ANALYSIS_SKIP_CONCURRENCY=1 for their general lint runs
+# — the empty override strips the escape hatch for this one step.
+timeout -k 10 300 env JAX_PLATFORMS=cpu ANALYSIS_SKIP_CONCURRENCY= python -m llm_weighted_consensus_tpu.analysis --rules LWC014,LWC015,LWC016 --no-jaxpr --no-mesh; rc_cc=$?; [ $rc -eq 0 ] && rc=$rc_cc; \
 if [ -z "${ANALYSIS_SKIP_MESH:-}" ]; then timeout -k 10 300 env JAX_PLATFORMS=cpu python -c 'import sys; from llm_weighted_consensus_tpu.analysis.mesh_audit import run_mesh_audit; fs = run_mesh_audit(); [print(f.render()) for f in fs]; sys.exit(1 if fs else 0)'; rc_mesh=$?; [ $rc -eq 0 ] && rc=$rc_mesh; fi; exit $rc

@@ -1,0 +1,56 @@
+"""Violating fixture for LWC014 (lock registry drift + unguarded field access).
+
+Self-contained: declares its own CONCURRENCY_MODEL so the analyzer
+checks this file against this table, not the package-wide one.
+
+Expected findings:
+  1. ``Worker._rogue`` — a threading.Lock with no registry row;
+  2. ``Ghost._lock`` — a registry row with no creation site (stale);
+  3. ``Worker._spin`` — mutates ``_count`` outside ``with self._lock``;
+  4. ``Worker.poll`` — reads ``_count`` with no lock at all;
+  5. ``Worker._bump_locked`` — caller-holds-lock exemption with no reason;
+  6. ``Worker.start`` — calls the exempted method without holding the lock.
+"""
+
+import threading
+
+CONCURRENCY_MODEL = {
+    "locks": {
+        "Worker._lock": {
+            "module": "lwc014_bad.py",
+            "kind": "lock",
+            "guards": ("_count",),
+        },
+        "Ghost._lock": {
+            "module": "lwc014_bad.py",
+            "kind": "lock",
+            "guards": ("_x",),
+        },
+    },
+    "order": (),
+    "order_runtime": (),
+}
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rogue = threading.Lock()
+        self._count = 0
+
+    def start(self):
+        threading.Thread(target=self._spin, daemon=True).start()
+        threading.Thread(target=self.poll, daemon=True).start()
+        self._bump_locked()
+
+    def _spin(self):
+        with self._lock:
+            self._count += 1
+        self._count += 1
+
+    def poll(self):
+        return self._count
+
+    # caller-holds-lock: Worker._lock
+    def _bump_locked(self):
+        self._count += 1

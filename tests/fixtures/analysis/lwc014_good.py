@@ -1,0 +1,44 @@
+"""Clean fixture for LWC014 (and every other rule).
+
+One registered lock guarding one field; every access is either inside
+``with self._lock`` or in a ``_locked``-suffixed helper whose
+caller-holds-lock exemption carries a reason AND whose only caller
+really does hold the lock at the call site.
+"""
+
+import threading
+
+CONCURRENCY_MODEL = {
+    "locks": {
+        "Worker._lock": {
+            "module": "lwc014_good.py",
+            "kind": "lock",
+            "guards": ("_count",),
+        },
+    },
+    "order": (),
+    "order_runtime": (),
+}
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def start(self):
+        threading.Thread(target=self._spin, daemon=True).start()
+        threading.Thread(target=self.read, daemon=True).start()
+
+    def _spin(self):
+        with self._lock:
+            self._count += 1
+            self._flush_locked()
+
+    # caller-holds-lock: Worker._lock (only _spin calls this, inside its with block)
+    def _flush_locked(self):
+        self._count = 0
+
+    def read(self):
+        with self._lock:
+            return self._count

@@ -1,0 +1,47 @@
+"""Violating fixture for LWC015 (lock-order inversion / DAG escape).
+
+The model declares LOCK_B -> LOCK_A, but the code nests the other way
+around, so the observed edge is undeclared AND observed+declared
+together form a cycle; the declared edge itself is never observed
+(stale).  ``renest`` re-acquires a non-reentrant Lock lexically.
+
+Expected findings:
+  1. ``forward`` — observed edge LOCK_A -> LOCK_B not in the declared DAG;
+  2. declared edge LOCK_B -> LOCK_A never observed (stale registry row);
+  3. cycle LOCK_A -> LOCK_B -> LOCK_A across observed+declared edges;
+  4. ``renest`` — lexical re-acquire of a plain (non-reentrant) Lock.
+"""
+
+import threading
+
+CONCURRENCY_MODEL = {
+    "locks": {
+        "LOCK_A": {
+            "module": "lwc015_bad.py",
+            "kind": "lock",
+            "guards": (),
+        },
+        "LOCK_B": {
+            "module": "lwc015_bad.py",
+            "kind": "lock",
+            "guards": (),
+        },
+    },
+    "order": (("LOCK_B", "LOCK_A"),),
+    "order_runtime": (),
+}
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward(items):
+    with LOCK_A:
+        with LOCK_B:
+            return list(items)
+
+
+def renest():
+    with LOCK_A:
+        with LOCK_A:
+            return None

@@ -1,0 +1,45 @@
+"""Clean fixture for LWC015 (and every other rule).
+
+Declared order LOCK_A -> LOCK_B is exactly what the code does, both
+lexically (``forward``) and call-mediated (``outer`` holds LOCK_A and
+calls ``helper`` which takes LOCK_B) — the observed graph and the
+declared DAG agree edge-for-edge.
+"""
+
+import threading
+
+CONCURRENCY_MODEL = {
+    "locks": {
+        "LOCK_A": {
+            "module": "lwc015_good.py",
+            "kind": "lock",
+            "guards": (),
+        },
+        "LOCK_B": {
+            "module": "lwc015_good.py",
+            "kind": "lock",
+            "guards": (),
+        },
+    },
+    "order": (("LOCK_A", "LOCK_B"),),
+    "order_runtime": (),
+}
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward(items):
+    with LOCK_A:
+        with LOCK_B:
+            return list(items)
+
+
+def helper(items):
+    with LOCK_B:
+        return len(items)
+
+
+def outer(items):
+    with LOCK_A:
+        return helper(items)

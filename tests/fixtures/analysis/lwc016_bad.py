@@ -1,0 +1,62 @@
+"""Violating fixture for LWC016 (blocking operation under a held lock).
+
+Expected findings:
+  1. ``Pump.flush`` — ``await`` while holding ``Pump._lock``;
+  2. ``Pump.drain`` — ``wait_device_ready`` while holding ``Pump._lock``;
+  3. ``Pump.fetch`` — upstream HTTP call while holding ``Pump._lock``;
+  4. ``Pump.cross_wait`` — waits on ``Pump._cond`` while holding only
+     ``Pump._lock`` (waiting releases the condition, not the lock);
+  5. ``Pump.probe_all`` — calls ``_probe`` (which blocks on device
+     readiness) while holding ``Pump._lock``.
+"""
+
+import threading
+
+import requests
+
+CONCURRENCY_MODEL = {
+    "locks": {
+        "Pump._lock": {
+            "module": "lwc016_bad.py",
+            "kind": "lock",
+            "guards": (),
+        },
+        "Pump._cond": {
+            "module": "lwc016_bad.py",
+            "kind": "condition",
+            "guards": (),
+        },
+    },
+    "order": (),
+    "order_runtime": (),
+}
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self.ready = False
+
+    async def flush(self):
+        with self._lock:
+            await self.push()
+
+    def drain(self, device):
+        with self._lock:
+            wait_device_ready(device)
+
+    def fetch(self, url):
+        with self._lock:
+            return requests.get(url, timeout=5)
+
+    def cross_wait(self):
+        with self._lock:
+            self._cond.wait()
+
+    def _probe(self, device):
+        wait_device_ready(device)
+
+    def probe_all(self, device):
+        with self._lock:
+            self._probe(device)

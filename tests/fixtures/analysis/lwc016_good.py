@@ -1,0 +1,74 @@
+"""Clean fixture for LWC016 (and every other rule).
+
+The three sanctioned shapes: snapshot-under-lock-then-block-outside
+(``Pump.drain``), ``Condition.wait`` on the condition that is actually
+held (releases it while waiting), and blocking under a registered
+``long_held: True`` gate (``Stage.stage`` — the gate exists to be held
+across device work, so LWC016 exempts it by declaration).
+
+NOTE: test_analysis.py appends an injected method to ``Pump`` to prove
+LWC016 catches an ``await`` under a held lock — keep ``Pump`` the last
+top-level statement in this file.
+"""
+
+import threading
+
+CONCURRENCY_MODEL = {
+    "locks": {
+        "Pump._lock": {
+            "module": "lwc016_good.py",
+            "kind": "lock",
+            "guards": (),
+        },
+        "Pump._cond": {
+            "module": "lwc016_good.py",
+            "kind": "condition",
+            "guards": (),
+        },
+        "Gate._cond": {
+            "module": "lwc016_good.py",
+            "kind": "condition",
+            "guards": (),
+            "acquire_via": ("held_open",),
+            "long_held": True,
+        },
+    },
+    "order": (),
+    "order_runtime": (),
+}
+
+
+class Gate:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def held_open(self):
+        return self._cond
+
+
+class Stage:
+    def __init__(self, gate):
+        self.gate = gate
+
+    def stage(self, device):
+        with self.gate.held_open():
+            wait_device_ready(device)
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self.ready = False
+        self.count = 0
+
+    def drain(self, device):
+        with self._lock:
+            n = self.count
+        wait_device_ready(device)
+        return n
+
+    def pump(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait()

@@ -50,6 +50,13 @@ EXPECTED_BAD = {
     "LWC012": 5,  # undeclared family + dead registry row + non-literal
     # name + the _total-suffixed counter header (undeclared + dead row)
     "LWC013": 2,  # jax.block_until_ready + .block_until_ready() method
+    "LWC014": 6,  # unregistered lock + stale registry row + 2 unguarded
+    # cross-thread accesses + reasonless exemption + exempted method
+    # called without the lock held
+    "LWC015": 4,  # undeclared observed edge + stale declared edge +
+    # order cycle + lexical re-acquire of a non-reentrant Lock
+    "LWC016": 5,  # await + wait_device_ready + upstream HTTP +
+    # cross-condition wait + call-mediated blocking, all under a held lock
 }
 
 
@@ -202,6 +209,154 @@ def test_cli_module_entry_point():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
     assert payload["findings"] == []
+
+
+# -- concurrency audit: injected regressions + registry drift ----------------
+#
+# Each test plants exactly the regression the rule exists to catch in a
+# copy of the conforming fixture and asserts the NAMED rule reports it —
+# the auditor must not just pass clean code, it must fail broken code.
+
+
+def _mutated(tmp_path, fixture, old, new):
+    src = (FIXTURES / fixture).read_text()
+    assert old in src, f"mutation anchor drifted in {fixture}"
+    path = tmp_path / fixture  # same filename: the inline model's
+    path.write_text(src.replace(old, new))  # module suffix still matches
+    return path
+
+
+def _conc_lint(path, rule):
+    return run_lint(paths=[path], rules=[RULES_BY_NAME[rule]])
+
+
+def test_lwc014_catches_deleted_with_guard(tmp_path):
+    """Injected regression: strip the ``with self._lock`` bracket off a
+    guarded-field read reachable from two threads."""
+    path = _mutated(
+        tmp_path,
+        "lwc014_good.py",
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self._count\n",
+        "    def read(self):\n        return self._count\n",
+    )
+    findings = _conc_lint(path, "LWC014")
+    assert [f.rule for f in findings] == ["LWC014"]
+    assert findings[0].symbol == "Worker.read"
+    assert "_count" in findings[0].message
+
+
+def test_lwc015_catches_reversed_lock_order(tmp_path):
+    """Injected regression: reverse the two-lock nesting in ``forward``
+    while ``outer``/``helper`` still walk the declared direction — the
+    undeclared inverse edge AND the resulting cycle both surface."""
+    path = _mutated(
+        tmp_path,
+        "lwc015_good.py",
+        "    with LOCK_A:\n"
+        "        with LOCK_B:\n"
+        "            return list(items)\n",
+        "    with LOCK_B:\n"
+        "        with LOCK_A:\n"
+        "            return list(items)\n",
+    )
+    findings = _conc_lint(path, "LWC015")
+    assert findings and all(f.rule == "LWC015" for f in findings)
+    assert any(
+        "`LOCK_B` -> `LOCK_A`" in f.message and "not declared" in f.message
+        for f in findings
+    )
+    assert any("cycle" in f.message for f in findings)
+
+
+def test_lwc016_catches_await_under_held_lock(tmp_path):
+    """Injected regression: append a coroutine to ``Pump`` that awaits
+    while holding the registered lock."""
+    src = (FIXTURES / "lwc016_good.py").read_text()
+    src += (
+        "\n    async def injected(self):\n"
+        "        with self._lock:\n"
+        "            await self.nothing()\n"
+    )
+    path = tmp_path / "lwc016_good.py"
+    path.write_text(src)
+    findings = _conc_lint(path, "LWC016")
+    assert [f.rule for f in findings] == ["LWC016"]
+    assert findings[0].symbol == "Pump.injected"
+    assert "await" in findings[0].message
+
+
+def test_lwc014_registry_drift_unregistered_lock(tmp_path):
+    """Both-ways check, way one: a new threading primitive without a
+    registry row fails the lint."""
+    path = _mutated(
+        tmp_path,
+        "lwc014_good.py",
+        "        self._lock = threading.Lock()\n",
+        "        self._lock = threading.Lock()\n"
+        "        self._extra = threading.Lock()\n",
+    )
+    findings = _conc_lint(path, "LWC014")
+    assert [f.rule for f in findings] == ["LWC014"]
+    assert "Worker._extra" in findings[0].message
+    assert "not in the lock-model registry" in findings[0].message
+
+
+def test_lwc014_registry_drift_stale_row(tmp_path):
+    """Both-ways check, way two: deleting the lock's creation site makes
+    its registry row stale — the row must be pruned, not left to rot."""
+    path = _mutated(
+        tmp_path,
+        "lwc014_good.py",
+        "        self._lock = threading.Lock()\n",
+        "",
+    )
+    findings = _conc_lint(path, "LWC014")
+    assert [f.rule for f in findings] == ["LWC014"]
+    assert findings[0].symbol == "Worker._lock"
+    assert "no creation site" in findings[0].message
+
+
+def test_lwc015_registry_drift_stale_order_edge(tmp_path):
+    """Both-ways check for the order DAG: if no code path walks the
+    declared edge any more, the declaration itself fails the lint."""
+    src = (FIXTURES / "lwc015_good.py").read_text()
+    for old, new in (
+        (
+            "    with LOCK_A:\n"
+            "        with LOCK_B:\n"
+            "            return list(items)\n",
+            "    with LOCK_B:\n        return list(items)\n",
+        ),
+        (
+            "    with LOCK_A:\n        return helper(items)\n",
+            "    return helper(items)\n",
+        ),
+    ):
+        assert old in src, "mutation anchor drifted in lwc015_good.py"
+        src = src.replace(old, new)
+    path = tmp_path / "lwc015_good.py"
+    path.write_text(src)
+    findings = _conc_lint(path, "LWC015")
+    assert [f.rule for f in findings] == ["LWC015"]
+    assert findings[0].symbol == "LOCK_A->LOCK_B"
+    assert "no longer observed" in findings[0].message
+
+
+def test_package_model_covers_every_primitive_both_ways():
+    """The acceptance: 100% registry coverage over the package's real
+    threading primitives, in both directions — every creation site has a
+    row (no LWC014 unregistered findings) and every row has a creation
+    site (no stale findings) on the committed tree."""
+    findings = run_lint(rules=[RULES_BY_NAME["LWC014"]])
+    drift = [
+        f
+        for f in findings
+        if "not in the lock-model registry" in f.message
+        or "no creation site" in f.message
+    ]
+    assert drift == [], "\n".join(f.render() for f in drift)
 
 
 # -- jaxpr audit -------------------------------------------------------------

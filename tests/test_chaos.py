@@ -393,6 +393,16 @@ def run_mesh_fault_drill(seed, rounds=10):
         # a dead mesh, whatever the seed deals
         fallback_embedder=embedder(),
     )
+    # runtime lockdep rides along: every drill run validates the real
+    # acquisition order against the declared DAG (package model)
+    from llm_weighted_consensus_tpu.analysis.witness import LockWitness
+
+    witness = LockWitness()
+    mgr._lock = witness.wrap_lock("MeshFaultManager._lock", mgr._lock)
+    witness.wrap_gate(mgr._shape_gate)
+    batcher._stats_lock = witness.wrap_lock(
+        "DeviceBatcher._stats_lock", batcher._stats_lock
+    )
     rounds_texts = [
         [f"drill round {r} candidate {i % 3}" for i in range(6)]
         for r in range(rounds)
@@ -414,13 +424,19 @@ def run_mesh_fault_drill(seed, rounds=10):
         for texts in rounds_texts
     ]
     answers = [np.asarray(c) for c in confs]
-    return sigs, (answers, refs), mgr.snapshot(), plan.snapshot()
+    return (
+        sigs,
+        (answers, refs),
+        mgr.snapshot(),
+        plan.snapshot(),
+        witness.snapshot(),
+    )
 
 
 def test_mesh_fault_drill_answers_survive_the_fault_mix():
     import numpy as np
 
-    _, (answers, refs), mgr_snap, plan_snap = run_mesh_fault_drill(SEED)
+    _, (answers, refs), mgr_snap, plan_snap, _ = run_mesh_fault_drill(SEED)
     # every round answered correctly despite the injected mix: faults
     # cost re-dispatches and rungs, never wrong numbers
     for got, want in zip(answers, refs):
@@ -431,9 +447,29 @@ def test_mesh_fault_drill_answers_survive_the_fault_mix():
     assert mgr_snap["ladder"] == [[4, 2], [2, 2], [1, 2]]
 
 
+def test_mesh_fault_drill_lock_witness_clean():
+    """The acceptance: the witness-enabled drill records real lock
+    traffic and sees ZERO order violations — and every observed edge is
+    already in the declared DAG (the runtime half of the registry's
+    both-ways contract)."""
+    from llm_weighted_consensus_tpu.analysis.concurrency_model import (
+        CONCURRENCY_MODEL,
+    )
+
+    *_, wit_snap = run_mesh_fault_drill(SEED)
+    assert wit_snap["acquisitions"] > 0  # the witness actually saw traffic
+    assert wit_snap["violations"] == [], wit_snap["violations"]
+    assert wit_snap["undeclared"] == [], wit_snap["undeclared"]
+    declared = {tuple(e) for e in CONCURRENCY_MODEL["order"]} | {
+        tuple(e[:2]) for e in CONCURRENCY_MODEL.get("order_runtime", ())
+    }
+    observed = {tuple(e["edge"]) for e in wit_snap["edges"]}
+    assert observed <= declared, observed - declared
+
+
 def test_mesh_fault_drill_is_deterministic():
-    a_sigs, _, a_mgr, a_plan = run_mesh_fault_drill(SEED)
-    b_sigs, _, b_mgr, b_plan = run_mesh_fault_drill(SEED)
+    a_sigs, _, a_mgr, a_plan, _ = run_mesh_fault_drill(SEED)
+    b_sigs, _, b_mgr, b_plan, _ = run_mesh_fault_drill(SEED)
     assert a_sigs == b_sigs
     assert a_plan == b_plan
     for key in ("downsizes", "re_dispatches", "current_shape", "epoch"):

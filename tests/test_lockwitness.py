@@ -1,0 +1,193 @@
+"""LockWitness unit tests: the runtime half of the concurrency audit.
+
+The static rules (LWC014-016) judge the call graph; the witness judges
+real acquisition order.  These tests drive the proxies directly with
+tiny inline models and assert the four behaviours the chaos/soak drills
+rely on: inversion detection (direct and through declared transitive
+edges), RLock re-entrancy staying legal while plain-Lock re-entry is a
+violation, ``Condition.wait`` releasing the held entry for the duration
+of the sleep, and the undeclared-edge ledger that closes the registry's
+both-ways contract at runtime.
+"""
+
+import threading
+
+from llm_weighted_consensus_tpu.analysis.witness import LockWitness
+
+
+def _model(kinds, order=(), order_runtime=()):
+    return {
+        "locks": {k: {"kind": v, "guards": ()} for k, v in kinds.items()},
+        "order": order,
+        "order_runtime": order_runtime,
+    }
+
+
+def _two_lock_witness(order=()):
+    w = LockWitness(_model({"A": "lock", "B": "lock"}, order=order))
+    a = w.wrap_lock("A", threading.Lock())
+    b = w.wrap_lock("B", threading.Lock())
+    return w, a, b
+
+
+def test_declared_order_is_clean():
+    w, a, b = _two_lock_witness(order=(("A", "B"),))
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    snap = w.snapshot()
+    assert snap["violations"] == []
+    assert snap["undeclared"] == []
+    assert snap["edges"] == [{"edge": ["A", "B"], "count": 3}]
+    assert snap["acquisitions"] == 6
+
+
+def test_inversion_against_declared_edge_is_violation():
+    w, a, b = _two_lock_witness(order=(("A", "B"),))
+    with b:
+        with a:  # reverse of the declared DAG
+            pass
+    snap = w.snapshot()
+    assert [v["kind"] for v in snap["violations"]] == ["inversion"]
+    assert snap["violations"][0]["edge"] == ["B", "A"]
+    # the inverse edge is also simply undeclared
+    assert snap["undeclared"] == [["B", "A"]]
+
+
+def test_inversion_against_observed_edge_is_violation():
+    """No declared order at all: the first observed direction becomes
+    the de-facto DAG, and walking it backwards later is the inversion."""
+    w, a, b = _two_lock_witness()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    snap = w.snapshot()
+    assert [v["kind"] for v in snap["violations"]] == ["inversion"]
+    assert snap["violations"][0]["edge"] == ["B", "A"]
+
+
+def test_inversion_through_declared_transitive_chain():
+    """A -> B -> C declared; acquiring A under C closes a cycle through
+    edges this process never even executed — reachability, not equality,
+    is the check."""
+    w = LockWitness(
+        _model(
+            {"A": "lock", "B": "lock", "C": "lock"},
+            order=(("A", "B"), ("B", "C")),
+        )
+    )
+    a = w.wrap_lock("A", threading.Lock())
+    c = w.wrap_lock("C", threading.Lock())
+    with c:
+        with a:
+            pass
+    snap = w.snapshot()
+    assert [v["kind"] for v in snap["violations"]] == ["inversion"]
+    assert snap["violations"][0]["edge"] == ["C", "A"]
+
+
+def test_rlock_reentry_is_legal():
+    w = LockWitness(_model({"R": "rlock"}))
+    r = w.wrap_lock("R", threading.RLock())
+    with r:
+        with r:
+            pass
+    snap = w.snapshot()
+    assert snap["violations"] == []
+    assert snap["edges"] == []  # self-edges are not order edges
+    assert snap["acquisitions"] == 2
+
+
+def test_plain_lock_reentry_is_violation():
+    # the model says non-reentrant Lock; the underlying primitive is an
+    # RLock so the test itself doesn't deadlock on the nested acquire —
+    # the witness judges by the registry's declared kind
+    w = LockWitness(_model({"L": "lock"}))
+    lock = w.wrap_lock("L", threading.RLock())
+    with lock:
+        with lock:
+            pass
+    snap = w.snapshot()
+    assert [v["kind"] for v in snap["violations"]] == ["reentrant"]
+    assert snap["violations"][0]["lock"] == "L"
+
+
+def test_condition_wait_releases_held_entry():
+    """``Condition.wait``/``wait_for`` atomically release the condition:
+    the proxy pops the held entry before delegating, so order edges are
+    judged against what the thread REALLY holds during the sleep."""
+    w = LockWitness(_model({"C": "condition"}))
+    cond = w.wrap_lock("C", threading.Condition())
+    held_during_wait = []
+
+    def pred():
+        held_during_wait.append(list(w._stack()))
+        return True
+
+    with cond:
+        assert w._stack() == ["C"]
+        cond.wait_for(pred, timeout=1.0)
+        # woke up: the entry is re-pushed
+        assert w._stack() == ["C"]
+    assert w._stack() == []  # no leak through the pop/re-push dance
+    assert held_during_wait and all(
+        "C" not in held for held in held_during_wait
+    )
+    assert w.snapshot()["violations"] == []
+
+
+def test_condition_wait_handoff_across_threads():
+    w = LockWitness(_model({"C": "condition"}, order=()))
+    cond = w.wrap_lock("C", threading.Condition())
+    state = {"ready": False}
+
+    def waiter():
+        with cond:
+            cond.wait_for(lambda: state["ready"], timeout=5.0)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    # the notifier can take the condition while the waiter sleeps in
+    # wait_for — proof the proxy released it, not just the primitive
+    with cond:
+        state["ready"] = True
+        cond.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert w.snapshot()["violations"] == []
+
+
+def test_wrap_gate_counts_gate_as_one_logical_lock():
+    from llm_weighted_consensus_tpu.resilience.meshfault import _ShapeGate
+
+    w = LockWitness(_model({"G": "condition", "L": "lock"}, order=(("G", "L"),)))
+    gate = w.wrap_gate(_ShapeGate(), key="G")
+    lock = w.wrap_lock("L", threading.Lock())
+    with gate.shared():
+        with lock:
+            pass
+    with gate.exclusive():
+        pass
+    snap = w.snapshot()
+    assert snap["violations"] == []
+    assert snap["undeclared"] == []
+    assert snap["edges"] == [{"edge": ["G", "L"], "count": 1}]
+
+
+def test_snapshot_and_summary_line_shape():
+    w, a, b = _two_lock_witness(order=(("A", "B"),))
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    line = w.summary_line()
+    assert line == (
+        "lock witness: 4 acquisitions, 2 edge(s), "
+        "1 undeclared, 1 violation(s)"
+    )

@@ -53,6 +53,9 @@ def test_sigterm_under_overload_drains_clean(tmp_path):
             # PR 2's seeded fault plan: mid-stream stalls ride along, so
             # the drain proves itself against misbehaving upstreams too
             "FAULT_PLAN": "seed=42,stall_mid=0.2,stall_ms=200",
+            # runtime lockdep rides the whole soak: the server wraps its
+            # registered locks and reports the evidence at drain
+            "LOCK_WITNESS": "1",
         }
     )
     proc = subprocess.Popen(
@@ -124,6 +127,14 @@ def test_sigterm_under_overload_drains_clean(tmp_path):
     assert rc == 0, f"server exited {rc}:\n{out[-2000:]}"
     assert exited_after_ms < DRAIN_TIMEOUT_MS + 15_000.0
     assert "draining (SIGTERM/SIGINT received)..." in out
+
+    # the witness-enabled soak prints its lockdep evidence on the way
+    # out — and a clean run means zero order violations under real load
+    wit_lines = [
+        line for line in out.splitlines() if line.startswith("lock witness:")
+    ]
+    assert wit_lines, "lock witness summary missing from drain output"
+    assert wit_lines[-1].endswith("0 violation(s)"), wit_lines[-1]
 
     statuses = [s for s, _ in results]
     admitted = [(s, t) for s, t in results if s == 200]

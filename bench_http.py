@@ -82,6 +82,18 @@ against a ``METRICS_DEVICE_TIMING=1`` and a ``=0`` service, both with
 acceptance >= 0.95) and the ``overlap`` gauge — device-busy union over
 wall — read from the timing-on service over a saturated burst
 (acceptance >= 0.8).
+
+``--fleet`` replaces the trio with the fleet-tier scenario (fleet/):
+THREE replicas on real localhost sockets sharing a static
+``FLEET_PEERS`` roster and ONE counting fake upstream, driven through
+three phases — cold (every fingerprint new, round-robin), warm (the
+same fingerprints re-requested on a DIFFERENT replica than computed
+them, so every hit crosses the peer-fetch wire), and a hot-key
+stampede (one fingerprint, open fan-in across all three replicas).
+Reports goodput and latency per phase plus the fake-upstream call
+count per phase; the numbers that matter are warm-phase upstream
+calls == 0 (peer fetch serves fleet-wide) and stampede upstream
+calls == 1 (cross-replica single-flight).
 """
 
 from __future__ import annotations
@@ -1282,6 +1294,187 @@ async def bench_mesh_faults(args) -> None:
     )
 
 
+async def bench_fleet(args) -> None:
+    """Fleet-tier goodput (fleet/): three replicas on real localhost
+    sockets, one shared counting fake upstream — cold / warm (every hit
+    crosses the peer wire) / hot-key stampede (one upstream fan-out
+    fleet-wide)."""
+    import os
+
+    import aiohttp
+    from aiohttp import web
+    from aiohttp.test_utils import unused_port
+
+    from llm_weighted_consensus_tpu.serve import Config
+    from llm_weighted_consensus_tpu.serve.__main__ import (
+        _fake_upstream,
+        build_service,
+    )
+
+    # judge-latency floor, same reasoning as --overload: with a 0 ms
+    # upstream every request is event-loop CPU and goodput reads
+    # single-core contention (client + 3 services + fake upstream share
+    # one thread), not the peer protocol's cost
+    os.environ.setdefault("FAKE_UPSTREAM_DELAY_MS", "25")
+    concurrency = min(args.concurrency, 8)
+
+    calls = {"n": 0}
+
+    async def counting_upstream(request):
+        calls["n"] += 1
+        return await _fake_upstream(request)
+
+    fake_port = unused_port()
+    fake_app = web.Application()
+    fake_app.router.add_post("/v1/chat/completions", counting_upstream)
+    fake_runner = web.AppRunner(fake_app)
+    await fake_runner.setup()
+    await web.TCPSite(fake_runner, "127.0.0.1", fake_port).start()
+
+    ports = [unused_port() for _ in range(3)]
+    roster = ",".join(f"http://127.0.0.1:{p}" for p in ports)
+    runners = [fake_runner]
+    bases = []
+    for port in ports:
+        config = Config.from_env(
+            {
+                # host-only replicas: the fleet tier is a score-path
+                # feature; the AOT store covers the device side
+                "EMBEDDER_MODEL": "",
+                "SCORE_CACHE_TTL": "600",
+                "FLEET_SELF": f"http://127.0.0.1:{port}",
+                "FLEET_PEERS": roster,
+                "OPENAI_API_BASE": f"http://127.0.0.1:{fake_port}/v1",
+                "OPENAI_API_KEY": "bench-key",
+            }
+        )
+        runner = web.AppRunner(build_service(config))
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        runners.append(runner)
+        bases.append(f"http://127.0.0.1:{port}")
+
+    rng = np.random.default_rng(3)
+    bodies = []
+    for i in range(args.requests):
+        words = " ".join(rng.choice(BENCH_WORDS, size=24).tolist())
+        bodies.append(
+            json.dumps(
+                {
+                    "stream": True,
+                    "messages": [{"role": "user", "content": words}],
+                    "model": {"llms": [{"model": "fake-judge"}]},
+                    "choices": [f"candidate a {i}", f"candidate b {i}"],
+                }
+            )
+        )
+
+    try:
+        async with aiohttp.ClientSession(
+            headers={"content-type": "application/json"}
+        ) as session:
+
+            async def drive(targets_and_bodies):
+                sem = asyncio.Semaphore(concurrency)
+                lat = []
+
+                async def one(base, body):
+                    async with sem:
+                        t0 = time.perf_counter()
+                        async with session.post(
+                            base + "/score/completions", data=body
+                        ) as resp:
+                            await resp.read()
+                            assert resp.status == 200, await resp.text()
+                        lat.append((time.perf_counter() - t0) * 1e3)
+
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(one(b, body) for b, body in targets_and_bodies)
+                )
+                return time.perf_counter() - t0, lat
+
+            def phase(total, lat, upstream):
+                return {
+                    "rps": round(len(lat) / total, 2),
+                    **_percentiles(lat),
+                    "upstream_calls": upstream,
+                }
+
+            # cold: every fingerprint new, round-robin across replicas
+            c0 = calls["n"]
+            cold_total, cold_lat = await drive(
+                [(bases[i % 3], b) for i, b in enumerate(bodies)]
+            )
+            cold = phase(cold_total, cold_lat, calls["n"] - c0)
+            # let fire-and-forget publishes land on the owners
+            await asyncio.sleep(0.3)
+
+            # warm: same fingerprints on a DIFFERENT replica than
+            # computed them — every hit crosses the peer-fetch wire
+            c0 = calls["n"]
+            warm_total, warm_lat = await drive(
+                [(bases[(i + 1) % 3], b) for i, b in enumerate(bodies)]
+            )
+            warm = phase(warm_total, warm_lat, calls["n"] - c0)
+
+            # hot-key stampede: ONE new fingerprint, open fan-in
+            hot_body = json.dumps(
+                {
+                    "stream": True,
+                    "messages": [
+                        {"role": "user", "content": "the hot question"}
+                    ],
+                    "model": {"llms": [{"model": "fake-judge"}]},
+                    "choices": ["candidate a", "candidate b"],
+                }
+            )
+            c0 = calls["n"]
+            hot_total, hot_lat = await drive(
+                [
+                    (bases[i % 3], hot_body)
+                    for i in range(len(bodies))
+                ]
+            )
+            hot = phase(hot_total, hot_lat, calls["n"] - c0)
+
+            fleet_counters = []
+            for base in bases:
+                async with session.get(base + "/metrics") as resp:
+                    fleet_counters.append(
+                        (await resp.json()).get("fleet", {})
+                    )
+
+        emit(
+            "/score/completions?fleet",
+            warm["rps"],
+            "requests/sec warm goodput",
+            requests=len(bodies),
+            concurrency=concurrency,
+            replicas=3,
+            cold=cold,
+            warm=warm,
+            hot_stampede=hot,
+            peer_fetch_hits=sum(
+                c.get("peer_fetch", {}).get("hits", 0)
+                for c in fleet_counters
+            ),
+            lease_waits=sum(
+                c.get("leases", {}).get("waits", 0)
+                for c in fleet_counters
+            ),
+            note=(
+                "3 replicas, one counting fake upstream; acceptance = "
+                "warm upstream_calls == 0 (peer fetch serves "
+                "fleet-wide) and hot_stampede upstream_calls == 1 "
+                "(cross-replica single-flight)"
+            ),
+        )
+    finally:
+        for runner in runners:
+            await runner.cleanup()
+
+
 async def main_async(args) -> None:
     import aiohttp
 
@@ -1296,6 +1489,9 @@ async def main_async(args) -> None:
         return
     if args.overlap:
         await bench_overlap(args)
+        return
+    if args.fleet:
+        await bench_fleet(args)
         return
     overload_env = None
     if args.overload:
@@ -1441,6 +1637,14 @@ def main() -> None:
         "METRICS_DEVICE_TIMING=1 vs =0 services (BATCH_PIPELINE=2); "
         "reports the goodput ratio (acceptance >= 0.95) and the overlap "
         "gauge over a saturated burst (acceptance >= 0.8)",
+    )
+    parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="run the fleet-tier scenario instead of the endpoint trio: "
+        "3 replicas sharing a FLEET_PEERS roster + one counting fake "
+        "upstream; cold / warm (peer-fetch) / hot-key-stampede goodput; "
+        "acceptance = warm upstream_calls 0, stampede upstream_calls 1",
     )
     parser.add_argument("--n", type=int, default=64)
     parser.add_argument("--requests", type=int, default=100)

@@ -378,6 +378,7 @@ class ScoreClient:
         resilience=None,
         bias_plan=None,
         ledger=None,
+        fleet=None,
     ) -> None:
         self.chat_client = chat_client
         self.model_fetcher = model_fetcher
@@ -407,6 +408,11 @@ class ScoreClient:
         # optional obs.OutcomeLedger: one record per scored request
         # (LEDGER_RING/LEDGER_DIR), the weight-learning training substrate
         self.ledger = ledger
+        # optional fleet.FleetCoordinator (FLEET_*): after winning the
+        # in-process single-flight slot, the leader additionally consults
+        # the fleet — peer cache fetch or a cross-replica lease — so a
+        # fleet-wide hot fingerprint hits upstream exactly once
+        self.fleet = fleet
 
     # -- unary (client.rs:71-91) --------------------------------------------
 
@@ -465,13 +471,29 @@ class ScoreClient:
                 return replay_stream(record)
             future = self.flights.claim(fp)
             if future is None:  # leader
+                # only the in-process leader talks to the fleet: one
+                # replica contributes at most one fleet participant per
+                # fingerprint, and every fleet failure mode resolves to
+                # plan "local" — exactly the pre-fleet behavior
+                plan, chunks = "local", None
+                if self.fleet is not None:
+                    plan, chunks = await self.fleet.begin(fp)
+                if plan == "hit":
+                    _decide("fleet_hit")
+                    self.cache.put_chunks(fp, chunks)
+                    self.flights.complete(fp, chunks)
+                    return replay_stream(chunks)
                 _decide("leader")
                 try:
                     live = await self._create_streaming_live(ctx, params)
                 except BaseException as e:
                     self.flights.fail(fp, e)
+                    if plan == "lease":
+                        self.fleet.abandon(fp)
                     raise
-                return self._record_and_stream(fp, live)
+                return self._record_and_stream(
+                    fp, live, lease=(plan == "lease")
+                )
             waits += 1
             if cspan is not None:
                 cspan.annotate(singleflight_waits=waits)
@@ -482,11 +504,15 @@ class ScoreClient:
             # leader abandoned (disconnect) or produced an uncacheable
             # stream: retry — this caller likely becomes the new leader
 
-    async def _record_and_stream(self, fp, live):
+    async def _record_and_stream(self, fp, live, lease: bool = False):
         """Leader path: stream live to this client while recording; on
         clean error-free completion the recording lands in the cache and
         resolves every follower.  Any other outcome (abandoned stream,
-        error items) releases the flight so followers retry as leaders."""
+        error items) releases the flight so followers retry as leaders.
+        With ``lease`` (the fleet granted this replica the cross-replica
+        slot) a clean completion also publishes to the owning replica,
+        and anything else releases the lease so fleet waiters fall back
+        instead of riding out the TTL."""
         import asyncio
 
         from ..cache import record_stream
@@ -498,6 +524,8 @@ class ScoreClient:
             done = True
             self.cache.put_chunks(fp, chunk_objs)
             self.flights.complete(fp, chunk_objs)
+            if lease:
+                self.fleet.publish(fp, chunk_objs)
 
         rec = record_stream(live, on_complete)
         try:
@@ -507,6 +535,8 @@ class ScoreClient:
             await rec.aclose()
             if not done:
                 self.flights.fail(fp, asyncio.CancelledError())
+                if lease:
+                    self.fleet.abandon(fp)
 
     # -- streaming (client.rs:93-465) ---------------------------------------
 

@@ -1,0 +1,46 @@
+"""Fleet tier: N gateway replicas serving as one coherent cache tier.
+
+Through PR 15 every replica was an island: a private result LRU
+(cache/store.py) and a per-process single-flight (cache/singleflight.py)
+meant a fleet-wide hot score request stampeded every replica's upstream
+judges independently.  This package adds the cross-replica half of the
+cache design (cf. Nishtala et al., *Scaling Memcache at Facebook*,
+NSDI'13):
+
+* ``membership``  — static-or-file-watched peer roster + consistent-hash
+  ownership of cache fingerprints (score/v1 + embed/v1 key space);
+* ``leases``      — the owner-side cross-replica single-flight table:
+  one lease per in-flight fingerprint, TTL-bounded so a dead holder
+  degrades to local compute, never a hang;
+* ``client``      — the peer HTTP surface (entry fetch / lease / publish
+  / handoff) with traceparent + deadline propagation and per-peer
+  circuit breakers;
+* ``coordinator`` — the glue the score client's front door and the
+  drain path call; every fleet failure collapses to ("local", None),
+  i.e. exactly the pre-fleet behavior;
+* ``handlers``    — the ``/fleet/v1/*`` aiohttp handlers, including the
+  wire-side replay admission guard (a peer can never be served a
+  degraded or errored record);
+* ``wire``        — record validation shared by publish and handoff.
+
+Everything here is single-event-loop asyncio: no threading primitives,
+so the concurrency-model registry (analysis/concurrency_model.py) gains
+no rows and the lockdep witness has nothing new to watch.
+"""
+
+from .client import FleetClient
+from .coordinator import FleetCoordinator
+from .handlers import register_fleet_routes
+from .leases import LeaseTable
+from .membership import FleetConfig, FleetMembership
+from .wire import clean_chunk_objs
+
+__all__ = [
+    "FleetClient",
+    "FleetConfig",
+    "FleetCoordinator",
+    "FleetMembership",
+    "LeaseTable",
+    "clean_chunk_objs",
+    "register_fleet_routes",
+]

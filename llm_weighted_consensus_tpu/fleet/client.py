@@ -1,0 +1,218 @@
+"""The peer-to-peer HTTP surface, guarded by the resilience stack.
+
+Every call a replica makes to a peer (entry fetch, lease claim/release,
+publish, drain handoff) goes through here, and every call:
+
+* injects the ambient ``traceparent`` (obs/propagate.py) so the
+  cross-replica hop appears as one stitched span tree;
+* clamps its timeout to the propagated request deadline
+  (resilience/deadline.py) — a peer leg may spend at most HALF the
+  remaining budget, so the local-compute fallback always has time left
+  to actually run (the "sheds instead of blocking" contract);
+* forwards the clamped budget as ``x-deadline-ms`` so the peer's own
+  deadline middleware bounds any server-side long-poll;
+* rides a per-peer circuit breaker (resilience/breaker.py): a dead or
+  flapping owner stops costing a connect timeout per request within a
+  few attempts, and the fleet degrades to N independent replicas.
+
+Failures never propagate: every method returns a "treat the fleet as
+absent" value and the caller falls back to today's local behavior.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..obs import propagate
+from ..resilience.breaker import BreakerConfig, BreakerRegistry
+from ..resilience.deadline import current_deadline
+from ..utils import jsonutil
+from .wire import clean_chunk_objs
+
+# a peer leg may consume at most this fraction of the remaining request
+# deadline (the rest is reserved for the local-compute fallback)
+DEADLINE_SHARE = 0.5
+
+# per-peer breaker: open after repeated transport failures, probe again
+# a few seconds later; deliberately NOT configurable per knob — the
+# fleet either reaches a peer or routes around it
+_BREAKER = BreakerConfig(
+    threshold=0.5, window=10, min_samples=3, cooldown_ms=3000.0
+)
+
+
+class FleetClient:
+    def __init__(
+        self,
+        self_url: str,
+        *,
+        fetch_timeout_ms: float = 2000.0,
+    ) -> None:
+        self.self_url = self_url
+        self.fetch_timeout_ms = fetch_timeout_ms
+        self.breakers = BreakerRegistry(_BREAKER)
+        self._session = None
+        self.peer_errors = 0
+        self.deadline_sheds = 0
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def _budget_ms(self, extra_ms: float = 0.0) -> Optional[float]:
+        """The timeout for one peer leg: the fetch timeout (+ any
+        explicit long-poll extension), clamped to DEADLINE_SHARE of the
+        propagated deadline.  None means the deadline is already spent —
+        shed the peer leg entirely."""
+        budget = self.fetch_timeout_ms + extra_ms
+        deadline = current_deadline()
+        if deadline is not None:
+            remaining_ms = deadline.remaining() * 1000.0
+            if remaining_ms <= 1.0:
+                return None
+            budget = min(budget, remaining_ms * DEADLINE_SHARE)
+        return max(1.0, budget)
+
+    async def _request(
+        self,
+        method: str,
+        peer: str,
+        path: str,
+        *,
+        body: Optional[dict] = None,
+        extra_ms: float = 0.0,
+    ):
+        """(status, json_obj) or None on any transport-level failure
+        (breaker open, deadline spent, connect/read error, timeout)."""
+        breaker = self.breakers.get(peer, "fleet")
+        if not breaker.allow():
+            return None
+        resolved = False
+        try:
+            budget_ms = self._budget_ms(extra_ms)
+            if budget_ms is None:
+                # deadline already spent: the peer's health was never
+                # probed — neither success nor failure
+                self.deadline_sheds += 1
+                return None
+            import aiohttp
+            import asyncio
+
+            headers = {
+                "content-type": "application/json",
+                "x-deadline-ms": str(int(budget_ms)),
+            }
+            propagate.inject(headers)
+            session = self._ensure_session()
+            try:
+                async with session.request(
+                    method,
+                    peer + path,
+                    headers=headers,
+                    data=(
+                        jsonutil.dumps(body) if body is not None else None
+                    ),
+                    timeout=aiohttp.ClientTimeout(
+                        total=budget_ms / 1000.0
+                    ),
+                ) as resp:
+                    payload = None
+                    if resp.content_type == "application/json":
+                        payload = jsonutil.loads(await resp.read())
+                    else:
+                        await resp.read()
+                    breaker.record_success()
+                    resolved = True
+                    return resp.status, payload
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                self.peer_errors += 1
+                breaker.record_failure()
+                resolved = True
+                return None
+        finally:
+            if not resolved:
+                breaker.release_probe()
+
+    # -- the peer protocol ----------------------------------------------------
+
+    async def fetch_entry(
+        self, owner: str, fp: str, *, wait_ms: float = 0.0
+    ) -> Tuple[str, Optional[list]]:
+        """("hit", chunks) | ("miss", None) | ("error", None).  With
+        ``wait_ms`` the owner long-polls its lease table before
+        answering, so a waiter gets the published entry in one trip."""
+        path = f"/fleet/v1/entry/{fp}"
+        if wait_ms > 0:
+            path += f"?wait_ms={int(wait_ms)}"
+        result = await self._request(
+            "GET", owner, path, extra_ms=wait_ms
+        )
+        if result is None:
+            return "error", None
+        status, payload = result
+        if status == 200 and isinstance(payload, dict):
+            chunks = clean_chunk_objs(payload.get("chunks"))
+            if chunks is not None:
+                return "hit", chunks
+            return "error", None
+        if status == 404:
+            return "miss", None
+        return "error", None
+
+    async def request_lease(self, owner: str, fp: str) -> str:
+        """"granted" | "wait" | "error"."""
+        result = await self._request(
+            "POST", owner, f"/fleet/v1/lease/{fp}",
+            body={"holder": self.self_url},
+        )
+        if result is None:
+            return "error"
+        status, payload = result
+        if status == 200 and isinstance(payload, dict):
+            return "granted" if payload.get("granted") else "wait"
+        return "error"
+
+    async def release_lease(self, owner: str, fp: str) -> None:
+        await self._request(
+            "DELETE", owner, f"/fleet/v1/lease/{fp}",
+            body={"holder": self.self_url},
+        )
+
+    async def publish_entry(
+        self, owner: str, fp: str, chunk_objs: list
+    ) -> bool:
+        result = await self._request(
+            "PUT", owner, f"/fleet/v1/entry/{fp}",
+            body={"holder": self.self_url, "chunks": chunk_objs},
+        )
+        return result is not None and result[0] in (200, 204)
+
+    async def handoff(self, target: str, entries: List[dict]) -> int:
+        """Drain-time hot-set transfer; the count the target accepted."""
+        result = await self._request(
+            "POST", target, "/fleet/v1/handoff",
+            body={"from": self.self_url, "entries": entries},
+        )
+        if result is None or result[0] != 200:
+            return 0
+        payload = result[1]
+        if isinstance(payload, dict):
+            return int(payload.get("accepted", 0))
+        return 0
+
+    def stats(self) -> dict:
+        return {
+            "peer_errors": self.peer_errors,
+            "deadline_sheds": self.deadline_sheds,
+            "breakers": self.breakers.snapshot(),
+        }

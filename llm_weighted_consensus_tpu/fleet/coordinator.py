@@ -1,0 +1,242 @@
+"""The fleet coordinator: what the rest of the service talks to.
+
+The score client's front door calls ``begin(fp)`` on a local cache miss
+(only the in-process single-flight LEADER ever gets here — in-process
+collapse still happens first, so one replica contributes at most one
+fleet participant per fingerprint).  The answer is one of:
+
+* ``("hit", chunks)``  — the owner had the entry; replay it locally.
+* ``("lease", None)``  — this replica holds the fleet-wide lease: go
+  upstream exactly as before the fleet existed, then ``publish`` (or
+  ``abandon`` on failure).
+* ``("local", None)``  — the fleet cannot help (no roster, owner dead,
+  breaker open, deadline nearly spent, lease wait timed out): behave
+  exactly as today.  Every failure path funnels here — a broken fleet
+  degrades to N independent replicas, never worse.
+
+The drain path calls ``handoff(cache)``: the departing replica's
+hottest live entries are pushed to the peers that will own them once it
+leaves the ring, before ``/readyz`` flips (serve/lifecycle.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional, Tuple
+
+from .client import FleetClient
+from .leases import LeaseTable
+from .membership import FleetConfig, FleetMembership
+
+# drain-time handoff: at most this many MRU entries leave with us
+HANDOFF_MAX_ENTRIES = 256
+
+
+class FleetCoordinator:
+    def __init__(
+        self,
+        config: FleetConfig,
+        *,
+        membership: Optional[FleetMembership] = None,
+        client: Optional[FleetClient] = None,
+        leases: Optional[LeaseTable] = None,
+        clock=time.monotonic,
+    ) -> None:
+        self.config = config
+        self.membership = membership or FleetMembership(config, clock=clock)
+        self.client = client or FleetClient(
+            self.membership.self_url,
+            fetch_timeout_ms=config.fetch_timeout_millis,
+        )
+        self.leases = leases or LeaseTable(config.lease_millis, clock=clock)
+        self.clock = clock
+        # attached by build_service: the owner-side score cache the
+        # /fleet/v1 handlers serve from and publish into
+        self.cache = None
+        self.peer_hits = 0
+        self.peer_misses = 0
+        self.peer_errors = 0
+        self.local_fallbacks = 0
+        self.publishes = 0
+        self.abandons = 0
+        self.rejected_publishes = 0
+        self.handoff_sent = 0
+        self.handoff_accepted = 0
+        self.handoff_received = 0
+        self.handoff_rejected = 0
+        # publish/release tasks in flight (kept so GC can't cancel them)
+        self._tasks: set = set()
+
+    # -- the front door -------------------------------------------------------
+
+    async def begin(self, fp: str) -> Tuple[str, Optional[list]]:
+        try:
+            return await self._begin(fp)
+        except Exception:
+            # the fleet must never break a request
+            self.local_fallbacks += 1
+            return "local", None
+
+    async def _begin(self, fp: str) -> Tuple[str, Optional[list]]:
+        owner = self.membership.owner(fp)
+        if owner is None:
+            self.local_fallbacks += 1
+            return "local", None
+        if owner == self.membership.self_url:
+            return await self._begin_as_owner(fp)
+        return await self._begin_as_peer(fp, owner)
+
+    async def _begin_as_owner(self, fp: str) -> Tuple[str, Optional[list]]:
+        """We own ``fp``: claim the lease locally; if a remote replica
+        holds it, wait for its publish (bounded by the lease TTL and the
+        deadline share) and re-check the cache."""
+        granted, future = self.leases.acquire(fp, self.membership.self_url)
+        if granted:
+            return "lease", None
+        timeout = min(
+            self.leases.remaining_sec(fp) or self.leases.ttl_sec,
+            self._wait_budget_sec(),
+        )
+        await self.leases.wait(future, timeout)
+        chunks = self.cache.get(fp) if self.cache is not None else None
+        if chunks is not None:
+            self.peer_hits += 1
+            return "hit", chunks
+        # holder abandoned, expired, or we ran out of patience: take the
+        # lease ourselves if free, else compute locally without one
+        granted, _ = self.leases.acquire(fp, self.membership.self_url)
+        if granted:
+            return "lease", None
+        self.local_fallbacks += 1
+        return "local", None
+
+    async def _begin_as_peer(
+        self, fp: str, owner: str
+    ) -> Tuple[str, Optional[list]]:
+        status, chunks = await self.client.fetch_entry(owner, fp)
+        if status == "hit":
+            self.peer_hits += 1
+            return "hit", chunks
+        if status == "error":
+            self.peer_errors += 1
+            self.local_fallbacks += 1
+            return "local", None
+        self.peer_misses += 1
+        lease = await self.client.request_lease(owner, fp)
+        if lease == "granted":
+            return "lease", None
+        if lease == "wait":
+            status, chunks = await self.client.fetch_entry(
+                owner, fp, wait_ms=self.config.lease_millis
+            )
+            if status == "hit":
+                self.peer_hits += 1
+                return "hit", chunks
+        self.local_fallbacks += 1
+        return "local", None
+
+    def _wait_budget_sec(self) -> float:
+        """How long an owner-side waiter may block on a remote holder:
+        the lease TTL, clamped to the deadline share the client applies
+        to peer legs."""
+        from ..resilience.deadline import current_deadline
+
+        from .client import DEADLINE_SHARE
+
+        budget = self.leases.ttl_sec
+        deadline = current_deadline()
+        if deadline is not None:
+            budget = min(budget, deadline.remaining() * DEADLINE_SHARE)
+        return max(0.001, budget)
+
+    # -- completion -----------------------------------------------------------
+
+    def publish(self, fp: str, chunk_objs: list) -> None:
+        """The lease holder's clean result landed in its local cache:
+        retire the lease (owner) or push the record to the owner (peer).
+        Fire-and-forget — the response stream must not wait on it."""
+        self.publishes += 1
+        owner = self.membership.owner(fp)
+        if owner is None:
+            return
+        if owner == self.membership.self_url:
+            self.leases.publish(fp)
+            return
+        self._spawn(self.client.publish_entry(owner, fp, chunk_objs))
+
+    def abandon(self, fp: str) -> None:
+        """The lease holder failed without a result: release so waiters
+        fall back to local compute instead of riding out the TTL."""
+        self.abandons += 1
+        owner = self.membership.owner(fp)
+        if owner is None:
+            return
+        if owner == self.membership.self_url:
+            self.leases.release(fp, self.membership.self_url)
+            return
+        self._spawn(self.client.release_lease(owner, fp))
+
+    def _spawn(self, coro) -> None:
+        try:
+            task = asyncio.get_event_loop().create_task(coro)
+        except RuntimeError:
+            coro.close()
+            return
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    # -- drain ----------------------------------------------------------------
+
+    async def handoff(self, cache) -> int:
+        """Push this replica's hottest live entries to their post-drain
+        owners.  Returns the number of entries peers accepted; any
+        failure is skipped (the fleet re-computes what it must)."""
+        if cache is None or not getattr(cache, "enabled", False):
+            return 0
+        by_target: dict = {}
+        for fp, chunk_objs, ttl_sec in cache.hot_entries(
+            HANDOFF_MAX_ENTRIES
+        ):
+            target = self.membership.owner_excluding_self(fp)
+            if target is None or target == self.membership.self_url:
+                continue
+            by_target.setdefault(target, []).append(
+                {"fp": fp, "chunks": chunk_objs, "ttl_sec": round(ttl_sec, 3)}
+            )
+        accepted = 0
+        for target, entries in by_target.items():
+            self.handoff_sent += len(entries)
+            got = await self.client.handoff(target, entries)
+            accepted += got
+        self.handoff_accepted += accepted
+        return accepted
+
+    async def close(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+        await self.client.close()
+
+    # -- observability --------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "membership": self.membership.snapshot(),
+            "peer_fetch": {
+                "hits": self.peer_hits,
+                "misses": self.peer_misses,
+                "errors": self.peer_errors,
+            },
+            "leases": self.leases.stats(),
+            "local_fallbacks": self.local_fallbacks,
+            "publishes": self.publishes,
+            "abandons": self.abandons,
+            "rejected_publishes": self.rejected_publishes,
+            "handoff": {
+                "sent": self.handoff_sent,
+                "accepted": self.handoff_accepted,
+                "received": self.handoff_received,
+                "rejected": self.handoff_rejected,
+            },
+            "client": self.client.stats(),
+        }

@@ -1,0 +1,149 @@
+"""``/fleet/v1/*``: the owner-side HTTP surface.
+
+Routes (all replica-to-replica; admission-exempt like the probe
+endpoints — each call is bounded, cheap, and sheds itself via the
+propagated deadline rather than occupying an admission slot):
+
+* ``GET    /fleet/v1/entry/{fp}``  — the cached record, or 404.  With
+  ``?wait_ms=N`` the owner long-polls its lease table first, so a
+  waiter picks up the publish in one round trip.
+* ``PUT    /fleet/v1/entry/{fp}``  — a lease holder publishes its
+  result: the record is re-validated by the wire-side replay admission
+  guard (fleet/wire.py) before it may enter the owner's cache; a dirty
+  record is refused with 422 and the lease is released so waiters fall
+  back to local compute.
+* ``POST   /fleet/v1/lease/{fp}``  — claim the cross-replica
+  single-flight lease; ``{"granted": bool}``.
+* ``DELETE /fleet/v1/lease/{fp}``  — abandon a held lease.
+* ``POST   /fleet/v1/handoff``     — a draining replica pushes its hot
+  set; every entry passes the same wire guard, and the remaining TTL
+  rides along so a handed-off entry can never outlive its original
+  lifetime.
+"""
+
+from __future__ import annotations
+
+from ..resilience.deadline import current_deadline
+from ..utils import jsonutil
+from .wire import clean_chunk_objs
+
+# a long-poll may hold the connection at most this long regardless of
+# what the caller asked for (connection hygiene, not a semantic bound)
+MAX_WAIT_MS = 30000.0
+
+
+def _json(obj, status: int = 200):
+    from aiohttp import web
+
+    return web.Response(
+        text=jsonutil.dumps(obj),
+        status=status,
+        content_type="application/json",
+    )
+
+
+async def _read_body(request) -> dict:
+    try:
+        obj = jsonutil.loads(await request.read())
+    except ValueError:
+        return {}
+    return obj if isinstance(obj, dict) else {}
+
+
+def register_fleet_routes(app, fleet) -> None:
+    """Wire the fleet peer endpoints onto the gateway app."""
+
+    async def entry_get(request):
+        fp = request.match_info["fp"]
+        cache = fleet.cache
+        record = cache.get(fp) if cache is not None else None
+        if record is None:
+            wait_ms = 0.0
+            try:
+                wait_ms = float(request.query.get("wait_ms", 0))
+            except ValueError:
+                pass
+            wait_ms = min(max(0.0, wait_ms), MAX_WAIT_MS)
+            future = fleet.leases.holder_future(fp) if wait_ms else None
+            if future is not None:
+                timeout = min(
+                    wait_ms / 1000.0,
+                    fleet.leases.remaining_sec(fp) or wait_ms / 1000.0,
+                )
+                deadline = current_deadline()
+                if deadline is not None:
+                    timeout = min(timeout, max(0.0, deadline.remaining()))
+                await fleet.leases.wait(future, timeout)
+                record = cache.get(fp) if cache is not None else None
+        if record is None:
+            return _json({"found": False}, status=404)
+        return _json({"found": True, "chunks": record})
+
+    async def entry_put(request):
+        fp = request.match_info["fp"]
+        body = await _read_body(request)
+        holder = str(body.get("holder", ""))
+        chunks = clean_chunk_objs(body.get("chunks"))
+        if chunks is None:
+            # dirty or corrupt record: never enters the cache, and the
+            # lease is released so waiters stop hoping for it
+            fleet.rejected_publishes += 1
+            fleet.leases.release(fp, holder)
+            return _json({"accepted": False}, status=422)
+        if fleet.cache is not None:
+            fleet.cache.put_chunks(fp, chunks)
+        fleet.leases.publish(fp)
+        return _json({"accepted": True})
+
+    async def lease_post(request):
+        fp = request.match_info["fp"]
+        body = await _read_body(request)
+        holder = str(body.get("holder", "")) or "unknown-peer"
+        granted, _ = fleet.leases.acquire(fp, holder)
+        return _json(
+            {
+                "granted": granted,
+                "ttl_ms": fleet.leases.ttl_sec * 1000.0,
+            }
+        )
+
+    async def lease_delete(request):
+        fp = request.match_info["fp"]
+        body = await _read_body(request)
+        holder = str(body.get("holder", ""))
+        fleet.leases.release(fp, holder)
+        return _json({"released": True})
+
+    async def handoff_post(request):
+        body = await _read_body(request)
+        entries = body.get("entries")
+        if not isinstance(entries, list):
+            return _json({"accepted": 0}, status=400)
+        accepted = 0
+        for entry in entries:
+            if not isinstance(entry, dict):
+                continue
+            fp = entry.get("fp")
+            chunks = clean_chunk_objs(entry.get("chunks"))
+            try:
+                ttl_sec = float(entry.get("ttl_sec", 0))
+            except (TypeError, ValueError):
+                ttl_sec = 0.0
+            if not isinstance(fp, str) or chunks is None or ttl_sec <= 0:
+                fleet.handoff_rejected += 1
+                continue
+            if fleet.cache is not None:
+                # the remaining lifetime travels with the entry: a
+                # handed-off record expires exactly when the original
+                # would have
+                fleet.cache.put_chunks(fp, chunks, ttl_sec=ttl_sec)
+            fleet.leases.publish(fp)
+            accepted += 1
+        fleet.handoff_received += accepted
+        return _json({"accepted": accepted})
+
+    app.router.add_get("/fleet/v1/entry/{fp}", entry_get)
+    app.router.add_put("/fleet/v1/entry/{fp}", entry_put)
+    app.router.add_post("/fleet/v1/lease/{fp}", lease_post)
+    app.router.add_delete("/fleet/v1/lease/{fp}", lease_delete)
+    app.router.add_post("/fleet/v1/handoff", handoff_post)

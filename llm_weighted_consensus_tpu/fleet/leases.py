@@ -1,0 +1,135 @@
+"""Owner-side cross-replica single-flight leases.
+
+The per-process ``SingleFlight`` (cache/singleflight.py) collapses
+identical concurrent requests *inside* one replica; this table is the
+same idea at the fleet level, held by the fingerprint's OWNER: the
+first claimant — local or a remote replica over ``POST
+/fleet/v1/lease/{fp}`` — is granted the lease and goes upstream, every
+later claimant waits for the publish instead of fanning out its own
+judge calls.  One upstream fan-out per hot fingerprint, fleet-wide.
+
+Unlike the in-process table, a lease here is TTL-bounded: the holder
+may be another process that dies mid-flight, and nothing can ``finally:``
+on its behalf.  An expired lease is simply re-grantable — the next
+claimant computes locally, which is exactly the pre-fleet behavior.
+The failure direction is deliberate: a lost lease costs one duplicate
+upstream fan-out, never a stuck request.
+
+Single event loop, no locks (the gateway's asyncio discipline): every
+method is synchronous bookkeeping except ``wait``, which awaits a
+future with a timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional, Tuple
+
+
+class LeaseTable:
+    def __init__(self, ttl_ms: float, *, clock=time.monotonic) -> None:
+        self.ttl_sec = max(0.001, ttl_ms / 1000.0)
+        self.clock = clock
+        # fp -> [holder, expires_at, future]; the future resolves True on
+        # publish, None on release/expiry (waiters re-check and fall back)
+        self._leases: dict = {}
+        self.granted = 0
+        self.waits = 0
+        self.published = 0
+        self.released = 0
+        self.expirations = 0
+
+    def _expire(self, fp: str, lease: list) -> None:
+        del self._leases[fp]
+        self.expirations += 1
+        if not lease[2].done():
+            lease[2].set_result(None)
+
+    def acquire(
+        self, fp: str, holder: str
+    ) -> Tuple[bool, Optional[asyncio.Future]]:
+        """(granted, wait_future): granted means ``holder`` now owns the
+        in-flight slot for ``fp`` and must publish or release; otherwise
+        the future resolves when the current holder publishes (True) or
+        abandons/expires (None)."""
+        now = self.clock()
+        lease = self._leases.get(fp)
+        if lease is not None and lease[1] <= now:
+            self._expire(fp, lease)
+            lease = None
+        if lease is not None:
+            if lease[0] == holder:
+                # re-claim by the same holder (a retry): extend, keep it
+                lease[1] = now + self.ttl_sec
+                return True, None
+            self.waits += 1
+            return False, lease[2]
+        future = asyncio.get_event_loop().create_future()
+        self._leases[fp] = [holder, now + self.ttl_sec, future]
+        self.granted += 1
+        return True, None
+
+    def holder_future(self, fp: str) -> Optional[asyncio.Future]:
+        """The active lease's publish future (long-poll handlers wait on
+        it), or None when nothing is in flight."""
+        lease = self._leases.get(fp)
+        if lease is None:
+            return None
+        if lease[1] <= self.clock():
+            self._expire(fp, lease)
+            return None
+        return lease[2]
+
+    def remaining_sec(self, fp: str) -> float:
+        lease = self._leases.get(fp)
+        if lease is None:
+            return 0.0
+        return max(0.0, lease[1] - self.clock())
+
+    def publish(self, fp: str) -> None:
+        """The holder's result landed (in the owner's cache): wake every
+        waiter with success and retire the lease."""
+        lease = self._leases.pop(fp, None)
+        self.published += 1
+        if lease is not None and not lease[2].done():
+            lease[2].set_result(True)
+
+    def release(self, fp: str, holder: str) -> None:
+        """The holder abandons without a result (its upstream fan-out
+        failed): waiters wake to None and fall back to local compute."""
+        lease = self._leases.get(fp)
+        if lease is None or lease[0] != holder:
+            return
+        del self._leases[fp]
+        self.released += 1
+        if not lease[2].done():
+            lease[2].set_result(None)
+
+    async def wait(
+        self, future: asyncio.Future, timeout_sec: float
+    ) -> Optional[bool]:
+        """Await a lease future for at most ``timeout_sec``; None on
+        timeout.  Shielded: one waiter's cancellation must not kill the
+        shared future other waiters hold."""
+        try:
+            return await asyncio.wait_for(
+                asyncio.shield(future), timeout=max(0.001, timeout_sec)
+            )
+        except asyncio.TimeoutError:
+            return None
+
+    def active(self) -> int:
+        now = self.clock()
+        return sum(1 for lease in self._leases.values() if lease[1] > now)
+
+    def stats(self) -> dict:
+        return {
+            "active": self.active(),
+            "granted": self.granted,
+            "waits": self.waits,
+            "published": self.published,
+            "released": self.released,
+            "expirations": self.expirations,
+            "ttl_sec": self.ttl_sec,
+        }

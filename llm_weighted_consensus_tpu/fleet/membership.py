@@ -1,0 +1,178 @@
+"""Fleet membership and consistent-hash ownership.
+
+The roster is a list of replica base URLs (including this replica's
+own ``FLEET_SELF``), either static (``FLEET_PEERS``) or read from a
+file re-checked on mtime change (``FLEET_PEERS_FILE`` — one URL per
+line, ``#`` comments).  Ownership of a cache fingerprint is decided by
+a classic consistent-hash ring: each peer contributes ``vnodes``
+points (xxh3-64 over ``"url#i"``), a key maps to the first point
+clockwise from its own hash, and adding or removing one peer moves
+only the keys that peer owned — the property that makes elastic
+scale-out and the drain-time hot-set handoff cheap.
+
+Hashing is xxh3 (the identity layer's function family), NOT Python's
+``hash()``: ring positions must agree across processes, and ``hash()``
+is salted per process by PYTHONHASHSEED.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import xxhash
+
+
+def _point(label: str) -> int:
+    return xxhash.xxh3_64_intdigest(label.encode("utf-8"))
+
+
+@dataclass
+class FleetConfig:
+    """Parsed FLEET_* knobs (serve/config.py ``fleet_config()``)."""
+
+    self_url: str
+    peers: List[str] = field(default_factory=list)
+    peers_file: Optional[str] = None
+    vnodes: int = 64
+    lease_millis: float = 10000.0
+    fetch_timeout_millis: float = 2000.0
+
+
+class FleetMembership:
+    """The roster + ring.  Pure computation plus an optional lazy file
+    re-read; safe to call from any task on the event loop."""
+
+    # peers-file mtime is re-checked at most this often (seconds)
+    RELOAD_INTERVAL_SEC = 1.0
+
+    def __init__(
+        self,
+        config: FleetConfig,
+        *,
+        clock=time.monotonic,
+    ) -> None:
+        self.config = config
+        self.self_url = config.self_url.rstrip("/")
+        self.clock = clock
+        self.reloads = 0
+        self._file_mtime: Optional[float] = None
+        self._last_check = 0.0
+        peers = list(config.peers)
+        if config.peers_file:
+            loaded = self._read_peers_file()
+            if loaded is not None:
+                peers = loaded
+        self._set_peers(peers)
+
+    # -- roster ---------------------------------------------------------------
+
+    def _read_peers_file(self) -> Optional[List[str]]:
+        """The peers file's roster, or None when unreadable (the caller
+        keeps the previous roster: a transiently missing file must not
+        empty the fleet)."""
+        path = self.config.peers_file
+        try:
+            mtime = os.stat(path).st_mtime
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+        except OSError:
+            return None
+        self._file_mtime = mtime
+        peers = []
+        for line in lines:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                peers.append(line)
+        return peers
+
+    def _set_peers(self, peers: List[str]) -> None:
+        self._peers = sorted({p.rstrip("/") for p in peers if p})
+        points = []
+        for peer in self._peers:
+            for i in range(max(1, self.config.vnodes)):
+                points.append((_point(f"{peer}#{i}"), peer))
+        points.sort()
+        self._ring_points = [h for h, _ in points]
+        self._ring_peers = [p for _, p in points]
+
+    def _maybe_reload(self) -> None:
+        if not self.config.peers_file:
+            return
+        now = self.clock()
+        if now - self._last_check < self.RELOAD_INTERVAL_SEC:
+            return
+        self._last_check = now
+        try:
+            mtime = os.stat(self.config.peers_file).st_mtime
+        except OSError:
+            return
+        if mtime == self._file_mtime:
+            return
+        loaded = self._read_peers_file()
+        if loaded is not None:
+            self._set_peers(loaded)
+            self.reloads += 1
+
+    @property
+    def peers(self) -> List[str]:
+        self._maybe_reload()
+        return list(self._peers)
+
+    # -- ownership ------------------------------------------------------------
+
+    def owner(self, fp: str) -> Optional[str]:
+        """The replica owning ``fp``, or None with an empty ring."""
+        self._maybe_reload()
+        if not self._ring_points:
+            return None
+        i = bisect.bisect_right(self._ring_points, _point(fp))
+        if i == len(self._ring_points):
+            i = 0
+        return self._ring_peers[i]
+
+    def owns(self, fp: str) -> bool:
+        return self.owner(fp) == self.self_url
+
+    def owner_excluding_self(self, fp: str) -> Optional[str]:
+        """Where ``fp`` lands once this replica leaves the ring — the
+        drain-time handoff target.  None when no other peer exists."""
+        self._maybe_reload()
+        others = [p for p in self._peers if p != self.self_url]
+        if not others:
+            return None
+        if len(others) == len(self._peers):
+            return self.owner(fp)
+        h = _point(fp)
+        best = None
+        for peer in others:
+            for i in range(max(1, self.config.vnodes)):
+                ph = _point(f"{peer}#{i}")
+                d = (ph - h) % (1 << 64)
+                if best is None or d < best[0]:
+                    best = (d, peer)
+        return best[1]
+
+    def owned_share(self, samples: int = 256) -> float:
+        """Estimated fraction of the key space this replica owns
+        (deterministic probe points; surfaced in /readyz + metrics)."""
+        if not self._ring_points:
+            return 0.0
+        owned = sum(
+            1
+            for i in range(samples)
+            if self.owner(f"fleet-share-probe:{i}") == self.self_url
+        )
+        return owned / float(samples)
+
+    def snapshot(self) -> dict:
+        return {
+            "self": self.self_url,
+            "peers": self.peers,
+            "owned_share": round(self.owned_share(), 4),
+            "vnodes": self.config.vnodes,
+            "roster_reloads": self.reloads,
+        }

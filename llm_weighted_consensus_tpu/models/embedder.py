@@ -257,6 +257,12 @@ class TpuEmbedder:
         # never touch the jit dispatch cache (zero new specializations
         # after startup — see jit_stats)
         self._aot = {}
+        # fleet-shared serialized-executable store (models/aot_store.py,
+        # AOT_CACHE_DIR; serve/__main__ attaches it before warmup): when
+        # set, _aot_compile deserializes a peer's executable instead of
+        # compiling, and persists anything it does compile
+        self.aot_store = None
+        self._aot_restored = 0
         # batches are padded up to a multiple of this before dispatch so
         # the dp split divides evenly (shard_embedder sets it to dp)
         self.batch_multiple = 1
@@ -474,6 +480,52 @@ class TpuEmbedder:
             return None
         return self._aot.get(key)
 
+    def aot_cache_meta(self) -> dict:
+        """The environment digest preimage for the shared executable
+        store (models/aot_store.py): everything that makes a serialized
+        executable non-portable.  Any difference between the compiling
+        and restoring replica lands them in different store namespaces,
+        so an incompatible artifact is never even opened."""
+        dev = jax.devices()[0]
+        return {
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_kind": dev.device_kind,
+            "device_count": jax.device_count(),
+            "config": repr(self.config),
+            "pooling": self.pooling,
+            "max_tokens": self.max_tokens,
+        }
+
+    def _aot_compile(self, timings, key, label, lower) -> None:
+        """Fill ``self._aot[key]``: from the shared artifact store when
+        a compatible serialized executable exists (AOT_CACHE_DIR), else
+        by lowering and compiling — then persisting the result so the
+        next replica (or this one after a restart) deserializes in
+        milliseconds instead.  ``lower`` is a thunk returning the
+        Lowered, deferred so a store hit skips tracing entirely."""
+        import time as _time
+
+        if key in self._aot:
+            return
+        store = self.aot_store
+        if store is not None:
+            t0 = _time.perf_counter()
+            compiled = store.load(key)
+            if compiled is not None:
+                self._aot[key] = compiled
+                self._aot_restored += 1
+                timings.append((
+                    f"{label} [deserialized]", _time.perf_counter() - t0
+                ))
+                return
+        t0 = _time.perf_counter()
+        compiled = lower().compile()
+        self._aot[key] = compiled
+        timings.append((label, _time.perf_counter() - t0))
+        if store is not None:
+            store.save(key, compiled)
+
     def aot_warmup(
         self,
         specs: list,
@@ -504,8 +556,6 @@ class TpuEmbedder:
         and the vote's collectives baked in; see ``_aot_warmup_mesh``.
 
         Returns [(label, seconds)] for startup logging."""
-        import time as _time
-
         if not self._aot_ready():
             raise RuntimeError(
                 "AOT warmup needs the single-device embedder or the "
@@ -525,63 +575,54 @@ class TpuEmbedder:
             s = _seq_bucket(s, self.max_tokens)
             ids_av = sds((n, s), jnp.int32)
             for use_fused in (True, False):
-                key = ("vote1", n, s, use_fused)
-                if key in self._aot:
-                    continue
-                t0 = _time.perf_counter()
-                self._aot[key] = _embed_and_vote.lower(
-                    self.params, ids_av, ids_av, temp_av,
-                    n, self.config, self.pooling, use_fused,
-                ).compile()
-                timings.append((
+                self._aot_compile(
+                    timings,
+                    ("vote1", n, s, use_fused),
                     f"consensus {n}x{s} fused={use_fused}",
-                    _time.perf_counter() - t0,
-                ))
+                    lambda a=ids_av, n=n, f=use_fused: _embed_and_vote.lower(
+                        self.params, a, a, temp_av,
+                        n, self.config, self.pooling, f,
+                    ),
+                )
             pad_b = _bucket(n, self.MAX_DEVICE_BATCH)
-            key = ("embed", pad_b, s)
-            if key not in self._aot:
-                b_av = sds((pad_b, s), jnp.int32)
-                t0 = _time.perf_counter()
-                self._aot[key] = bert.embed.lower(
-                    self.params, b_av, b_av, self.config,
+            b_av = sds((pad_b, s), jnp.int32)
+            self._aot_compile(
+                timings,
+                ("embed", pad_b, s),
+                f"embed {pad_b}x{s}",
+                lambda a=b_av: bert.embed.lower(
+                    self.params, a, a, self.config,
                     pooling=self.pooling, normalize=True,
-                ).compile()
-                timings.append((
-                    f"embed {pad_b}x{s}", _time.perf_counter() - t0
-                ))
+                ),
+            )
             for r in r_buckets:
                 if r < 2:
                     continue  # R=1 groups dispatch the single-request path
-                key = ("many", r, n, s)
-                if key in self._aot:
-                    continue
                 flat_av = sds((r * n, s), jnp.int32)
-                t0 = _time.perf_counter()
-                self._aot[key] = _embed_and_vote_many.lower(
-                    self.params, flat_av, flat_av, temp_av,
-                    r, n, self.config, self.pooling,
-                ).compile()
-                timings.append((
-                    f"grouped R={r} {n}x{s}", _time.perf_counter() - t0
-                ))
+                self._aot_compile(
+                    timings,
+                    ("many", r, n, s),
+                    f"grouped R={r} {n}x{s}",
+                    lambda a=flat_av, r=r, n=n: _embed_and_vote_many.lower(
+                        self.params, a, a, temp_av,
+                        r, n, self.config, self.pooling,
+                    ),
+                )
         # packed-capacity buckets (continuous batching, serve/packing.py):
         # (rows, row_tokens, max_segments) triples — the small fixed set
         # replacing the (R, N, S) lattice on the packed dispatch path
         for b_rows, l_tokens, k_segs in packed_buckets:
-            key = ("packed", b_rows, l_tokens, k_segs)
-            if key in self._aot:
-                continue
             row_av = sds((b_rows, l_tokens), jnp.int32)
             starts_av = sds((b_rows, k_segs), jnp.int32)
-            t0 = _time.perf_counter()
-            self._aot[key] = bert.embed_packed.lower(
-                self.params, row_av, row_av, row_av, starts_av,
-                self.config, pooling=self.pooling, normalize=True,
-            ).compile()
-            timings.append((
+            self._aot_compile(
+                timings,
+                ("packed", b_rows, l_tokens, k_segs),
                 f"packed {b_rows}x{l_tokens}/k{k_segs}",
-                _time.perf_counter() - t0,
-            ))
+                lambda a=row_av, st=starts_av: bert.embed_packed.lower(
+                    self.params, a, a, a, st,
+                    self.config, pooling=self.pooling, normalize=True,
+                ),
+            )
         return timings
 
     def _aot_warmup_mesh(
@@ -602,8 +643,6 @@ class TpuEmbedder:
         (N, S) — the mesh vote always traces its temperature (the fused
         Pallas variant is single-device-only), so there is no
         ``use_fused`` split here."""
-        import time as _time
-
         sds = jax.ShapeDtypeStruct
         bm = self.batch_multiple
         dp, tp = self.mesh_shape
@@ -616,66 +655,61 @@ class TpuEmbedder:
         timings = []
         for n, s in specs:
             s = _seq_bucket(s, self.max_tokens)
-            key = self._aot_key(("vote1", n, s))
-            if key not in self._aot:
-                pad_n = n + (-n) % bm
-                t0 = _time.perf_counter()
-                self._aot[key] = _mesh_embed_and_vote.lower(
-                    self.params, iav(pad_n, s), iav(pad_n, s), temp_av,
+            pad_n = n + (-n) % bm
+            self._aot_compile(
+                timings,
+                self._aot_key(("vote1", n, s)),
+                f"{tag} consensus {n}x{s}",
+                lambda a=iav(pad_n, s), n=n: _mesh_embed_and_vote.lower(
+                    self.params, a, a, temp_av,
                     n, self.config, self.pooling, self.mesh,
-                ).compile()
-                timings.append((
-                    f"{tag} consensus {n}x{s}", _time.perf_counter() - t0
-                ))
+                ),
+            )
             pad_b = _bucket(n, self.MAX_DEVICE_BATCH)
             pad_b += (-pad_b) % bm
-            key = self._aot_key(("embed", pad_b, s))
-            if key not in self._aot:
-                t0 = _time.perf_counter()
-                self._aot[key] = bert.embed.lower(
-                    self.params, iav(pad_b, s), iav(pad_b, s), self.config,
+            self._aot_compile(
+                timings,
+                self._aot_key(("embed", pad_b, s)),
+                f"{tag} embed {pad_b}x{s}",
+                lambda a=iav(pad_b, s): bert.embed.lower(
+                    self.params, a, a, self.config,
                     pooling=self.pooling, normalize=True,
-                ).compile()
-                timings.append((
-                    f"{tag} embed {pad_b}x{s}", _time.perf_counter() - t0
-                ))
+                ),
+            )
             for r in r_buckets:
                 if r < 2:
                     continue  # R=1 groups dispatch the single-request path
-                key = self._aot_key(("many", r, n, s))
-                if key in self._aot:
-                    continue
                 flat_n = r * n + (-(r * n)) % bm
-                t0 = _time.perf_counter()
-                self._aot[key] = _embed_and_vote_many.lower(
-                    self.params, iav(flat_n, s), iav(flat_n, s), temp_av,
-                    r, n, self.config, self.pooling,
-                ).compile()
-                timings.append((
+                self._aot_compile(
+                    timings,
+                    self._aot_key(("many", r, n, s)),
                     f"{tag} grouped R={r} {n}x{s}",
-                    _time.perf_counter() - t0,
-                ))
+                    lambda a=iav(flat_n, s), r=r, n=n: (
+                        _embed_and_vote_many.lower(
+                            self.params, a, a, temp_av,
+                            r, n, self.config, self.pooling,
+                        )
+                    ),
+                )
         for b_rows, l_tokens, k_segs in packed_buckets:
             # the packed dispatch pads its row dim to the dp multiple
             # (all-zero rows: segment id 0 is the fully-masked pad slot,
             # which forwards cleanly), so warm the padded bucket
             pb = b_rows + (-b_rows) % bm
-            key = self._aot_key(("packed", pb, l_tokens, k_segs))
-            if key in self._aot:
-                continue
             starts_av = sds(
                 (pb, k_segs), jnp.int32, sharding=self.batch_sharding
             )
-            t0 = _time.perf_counter()
-            self._aot[key] = bert.embed_packed.lower(
-                self.params, iav(pb, l_tokens), iav(pb, l_tokens),
-                iav(pb, l_tokens), starts_av,
-                self.config, pooling=self.pooling, normalize=True,
-            ).compile()
-            timings.append((
+            self._aot_compile(
+                timings,
+                self._aot_key(("packed", pb, l_tokens, k_segs)),
                 f"{tag} packed {pb}x{l_tokens}/k{k_segs}",
-                _time.perf_counter() - t0,
-            ))
+                lambda a=iav(pb, l_tokens), st=starts_av: (
+                    bert.embed_packed.lower(
+                        self.params, a, a, a, st,
+                        self.config, pooling=self.pooling, normalize=True,
+                    )
+                ),
+            )
         # long-context ring buckets (N, S): only meaningful with an sp
         # mesh axis — without one the ring shard_map has no axis to ring
         # over, and warming nothing here keeps the 2-axis AOT table
@@ -694,33 +728,29 @@ class TpuEmbedder:
             for n, s in ring_buckets:
                 s = _seq_bucket(s, self.ring_max_tokens)
                 s = min(s + (-s) % sp, self.ring_max_tokens)
-                key = self._ring_aot_key(("ring_vote", n, s))
-                if key not in self._aot:
-                    pad_n = n + (-n) % bm
-                    t0 = _time.perf_counter()
-                    self._aot[key] = _ring_embed_and_vote.lower(
-                        self.params, rav(pad_n, s), rav(pad_n, s), temp_av,
+                pad_n = n + (-n) % bm
+                self._aot_compile(
+                    timings,
+                    self._ring_aot_key(("ring_vote", n, s)),
+                    f"{rtag} ring consensus {n}x{s}",
+                    lambda a=rav(pad_n, s), n=n: _ring_embed_and_vote.lower(
+                        self.params, a, a, temp_av,
                         n, self._ring_config, self.mesh, "sp", "dp",
                         self.pooling,
-                    ).compile()
-                    timings.append((
-                        f"{rtag} ring consensus {n}x{s}",
-                        _time.perf_counter() - t0,
-                    ))
+                    ),
+                )
                 pad_b = _bucket(n, self.MAX_DEVICE_BATCH)
                 pad_b += (-pad_b) % bm
-                key = self._ring_aot_key(("ring", pad_b, s))
-                if key not in self._aot:
-                    t0 = _time.perf_counter()
-                    self._aot[key] = _ring_embed_jit.lower(
-                        self.params, rav(pad_b, s), rav(pad_b, s),
+                self._aot_compile(
+                    timings,
+                    self._ring_aot_key(("ring", pad_b, s)),
+                    f"{rtag} ring embed {pad_b}x{s}",
+                    lambda a=rav(pad_b, s): _ring_embed_jit.lower(
+                        self.params, a, a,
                         self._ring_config, self.mesh, "sp", "dp",
                         self.pooling, True,
-                    ).compile()
-                    timings.append((
-                        f"{rtag} ring embed {pad_b}x{s}",
-                        _time.perf_counter() - t0,
-                    ))
+                    ),
+                )
         return timings
 
     def aot_mesh_shapes(self) -> list:
@@ -743,6 +773,7 @@ class TpuEmbedder:
 
         return {
             "aot_buckets": len(self._aot),
+            "aot_restored": self._aot_restored,
             "specializations": {
                 "embed_and_vote": _embed_and_vote._cache_size(),
                 "embed_and_vote_many": _embed_and_vote_many._cache_size(),

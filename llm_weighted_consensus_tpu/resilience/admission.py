@@ -235,9 +235,13 @@ def admission_middleware(admission: AdmissionController):
     @web.middleware
     async def _mw(request, handler):
         # /v1/traces rides the probe exemption: operators debugging an
-        # overload need to READ traces exactly while the gate sheds
+        # overload need to READ traces exactly while the gate sheds.
+        # /fleet/v1 does too: peer calls are cheap bounded cache/lease
+        # bookkeeping that must keep answering while the gate sheds —
+        # an overloaded replica that stops granting leases would turn
+        # fleet-wide single-flight into a fleet-wide stampede
         if request.path in EXEMPT_PATHS or request.path.startswith(
-            "/v1/traces"
+            ("/v1/traces", "/fleet/v1")
         ):
             return await handler(request)
         t_wait = time.perf_counter()

@@ -786,6 +786,17 @@ def build_service(
         # serializes the dispatch pipeline; =0 only darkens the device
         # rows, roofline attainment and the overlap gauge
         embedder.device_timing = config.metrics_device_timing
+    if embedder is not None and config.aot_cache_dir:
+        # AOT_CACHE_DIR: fleet-shared serialized-executable store — the
+        # warmup below deserializes any bucket a peer (or a previous run
+        # of this replica) already compiled, and persists what it
+        # compiles itself.  Attached before warmup so the very first
+        # _aot_compile call can restore.
+        from ..models.aot_store import AotStore
+
+        embedder.aot_store = AotStore(
+            config.aot_cache_dir, meta=embedder.aot_cache_meta()
+        )
     packed_buckets = []
     if embedder is not None and config.warmup:
         if config.packing_enabled and embedder.supports_packing():
@@ -938,6 +949,17 @@ def build_service(
                 config.score_cache_ttl_sec,
                 config.score_cache_embed_max_bytes,
             )
+    # FLEET_*: the replicated-cache tier (fleet/).  Config validation
+    # guarantees the score cache exists whenever the fleet is on; the
+    # coordinator serves owner-side state from it and peers publish
+    # into it (fleet/handlers.py)
+    fleet = None
+    fleet_cfg = config.fleet_config()
+    if fleet_cfg is not None and score_cache is not None:
+        from ..fleet import FleetCoordinator
+
+        fleet = FleetCoordinator(fleet_cfg)
+        fleet.cache = score_cache
     # device watchdog (DEVICE_WATCHDOG_MILLIS > 0): brackets every
     # batched dispatch; a hung PJRT call flips readiness and — with the
     # CPU fallback built below — reroutes device work off the chip
@@ -1151,6 +1173,9 @@ def build_service(
         # JUDGE_BIAS_PLAN: deterministic vote perturbation (drills only)
         bias_plan=config.judge_bias_injection_plan(),
         ledger=ledger,
+        # FLEET_*: cross-replica peer fetch + single-flight leases; None
+        # preserves single-replica behavior
+        fleet=fleet,
     )
     multichat_client = MultichatClient(
         chat_client, model_registry, archive_fetcher=store
@@ -1196,6 +1221,9 @@ def build_service(
         watchdog=watchdog,
         meshfault=meshfault,
         drain_timeout_ms=config.drain_timeout_millis,
+        # FLEET_*: the drain hands this replica's hot set to its
+        # post-drain owners before /readyz flips
+        fleet=fleet,
     )
     app = build_app(
         gw_chat,
@@ -1215,6 +1243,7 @@ def build_service(
         # TRACE_*: request tracing (obs/); None preserves untraced behavior
         trace_sink=config.trace_sink(),
         ledger=ledger,
+        fleet=fleet,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables
@@ -1255,6 +1284,12 @@ def build_service(
         await transport.close()
 
     app.on_cleanup.append(_close_transport)
+    if fleet is not None:
+
+        async def _close_fleet(app):
+            await fleet.close()
+
+        app.on_cleanup.append(_close_fleet)
     if watchdog is not None:
         # signal-free shutdowns (tests, embedding into another runner)
         # must still stop the monitor thread; stop() is idempotent with

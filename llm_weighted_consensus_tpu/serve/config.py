@@ -191,6 +191,42 @@ TPU additions:
 Cache counters (hits/misses/evictions/in-flight collapses) surface as
 the ``score_cache`` / ``embed_cache`` sections of ``GET /metrics``.
 
+Fleet tier (fleet/): N gateway replicas with ``FLEET_*`` set serve as
+ONE tier — consistent-hash ownership of cache fingerprints, peer-to-peer
+result fetch before going upstream, cross-replica single-flight leases
+(a fleet-wide hot key hits the upstream judges exactly once), and
+drain-time hot-set handoff.  Everything unset = single-replica behavior
+untouched; a dead or unreachable peer degrades to exactly that:
+
+* ``FLEET_SELF`` — this replica's own base URL as peers reach it
+  (e.g. ``http://10.0.0.3:5000``).  Required to enable the fleet;
+  requires ``SCORE_CACHE_TTL`` > 0 (the fleet shares score-cache
+  entries) and a roster via one of the next two knobs.
+* ``FLEET_PEERS`` — static comma-separated roster of replica base URLs,
+  ``FLEET_SELF`` included.
+* ``FLEET_PEERS_FILE`` — file-watched roster instead (one URL per
+  line, ``#`` comments allowed), re-read within ~1 s of an mtime
+  change so replicas join/leave without restarts.  Mutually exclusive
+  with ``FLEET_PEERS``.
+* ``FLEET_VNODES`` — virtual nodes per replica on the ownership ring
+  (higher = smoother key balance, larger ring).  Default 64.
+* ``FLEET_LEASE_MILLIS`` — cross-replica single-flight lease TTL: how
+  long the owner waits for a lease holder's publish before waiters
+  fall back to local compute (a dead holder costs one duplicate
+  fan-out, never a stuck request).  Default 10000.
+* ``FLEET_FETCH_TIMEOUT_MILLIS`` — per-peer-call timeout, always
+  additionally clamped to HALF the remaining request deadline so the
+  local-compute fallback keeps enough budget to run.  Default 2000.
+* ``AOT_CACHE_DIR`` — fleet-shared serialized-executable store
+  (models/aot_store.py): the first replica to AOT-compile a warmup
+  bucket serializes the executable here, and every later replica (or
+  restart) deserializes in milliseconds instead of compiling —
+  seconds-fast warm cold start, zero jit compilations on the first
+  request.  Keyed by an environment digest (jax version, backend,
+  device kind/count, model config), so incompatible artifacts are
+  never even opened.  Useful fleet or single-replica; independent of
+  the ``FLEET_*`` knobs.
+
 Resilience (all opt-in; everything unset = pre-resilience behavior,
 byte for byte):
 
@@ -528,6 +564,14 @@ def _parse_mesh_shape(raw) -> Optional[tuple]:
     return tuple(parts)
 
 
+def _parse_peer_list(raw) -> list:
+    """"http://a:5000, http://b:5000" -> normalized URL list (trailing
+    slashes stripped, empties dropped)."""
+    if not raw:
+        return []
+    return [p.strip().rstrip("/") for p in str(raw).split(",") if p.strip()]
+
+
 def _non_negative_int(env: dict, name: str, default: int) -> int:
     value = int(env.get(name, default))
     if value < 0:
@@ -723,6 +767,18 @@ class Config:
     # deterministic judge-vote perturbation spec (JudgeBiasPlan.parse);
     # None = off (consensus-quality drills and tier-1 tests only)
     judge_bias_plan: Optional[str] = None
+    # fleet tier (fleet/): replicated score cache with consistent-hash
+    # ownership and cross-replica single-flight leases.  fleet_self
+    # unset = everything off; fleet_config() returns None
+    fleet_self: Optional[str] = None
+    fleet_peers: list = field(default_factory=list)
+    fleet_peers_file: Optional[str] = None
+    fleet_vnodes: int = 64
+    fleet_lease_millis: float = 10000.0
+    fleet_fetch_timeout_millis: float = 2000.0
+    # fleet-shared serialized-executable store (models/aot_store.py);
+    # None = compile every AOT bucket locally as before
+    aot_cache_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "Config":
@@ -900,6 +956,15 @@ class Config:
             ledger_ring=_non_negative_int(env, "LEDGER_RING", 0),
             ledger_dir=env.get("LEDGER_DIR"),
             judge_bias_plan=env.get("JUDGE_BIAS_PLAN"),
+            fleet_self=env.get("FLEET_SELF"),
+            fleet_peers=_parse_peer_list(env.get("FLEET_PEERS")),
+            fleet_peers_file=env.get("FLEET_PEERS_FILE"),
+            fleet_vnodes=max(1, int(env.get("FLEET_VNODES", 64))),
+            fleet_lease_millis=get_f("FLEET_LEASE_MILLIS", 10000),
+            fleet_fetch_timeout_millis=get_f(
+                "FLEET_FETCH_TIMEOUT_MILLIS", 2000
+            ),
+            aot_cache_dir=env.get("AOT_CACHE_DIR"),
         )
         if config.quality_window < 1:
             raise ValueError(
@@ -1027,6 +1092,52 @@ class Config:
                 "warmup needs NxS shapes to compile (set WARMUP, e.g. "
                 "WARMUP=64x112 WARMUP_R=2)"
             )
+        if config.fleet_peers and config.fleet_peers_file:
+            raise ValueError(
+                "FLEET_PEERS and FLEET_PEERS_FILE are mutually exclusive: "
+                "one roster source of truth (static list OR watched file)"
+            )
+        if (config.fleet_peers or config.fleet_peers_file) and (
+            not config.fleet_self
+        ):
+            raise ValueError(
+                "a fleet roster is set but FLEET_SELF is not: replicas "
+                "must know their own base URL to place themselves on the "
+                "ownership ring (set e.g. FLEET_SELF=http://10.0.0.3:5000)"
+            )
+        if config.fleet_self:
+            if not (config.fleet_peers or config.fleet_peers_file):
+                raise ValueError(
+                    "FLEET_SELF is set but no roster is: the fleet needs "
+                    "FLEET_PEERS (static) or FLEET_PEERS_FILE (watched) — "
+                    "a roster of one is valid but must be explicit"
+                )
+            if config.fleet_peers and (
+                config.fleet_self.rstrip("/") not in config.fleet_peers
+            ):
+                raise ValueError(
+                    f"FLEET_SELF={config.fleet_self} is not in FLEET_PEERS: "
+                    "the static roster must include this replica, or peers "
+                    "would route its owned keys elsewhere"
+                )
+            if config.score_cache_ttl_sec <= 0:
+                raise ValueError(
+                    "FLEET_SELF is set but SCORE_CACHE_TTL is 0: the fleet "
+                    "tier replicates score-cache entries, so without a "
+                    "cache there is nothing to own, lease, or hand off "
+                    "(set SCORE_CACHE_TTL > 0)"
+                )
+            if config.fleet_lease_millis <= 0:
+                raise ValueError(
+                    f"FLEET_LEASE_MILLIS={config.fleet_lease_millis} must "
+                    "be > 0 (the lease TTL bounds how long waiters trust a "
+                    "possibly-dead holder)"
+                )
+            if config.fleet_fetch_timeout_millis <= 0:
+                raise ValueError(
+                    f"FLEET_FETCH_TIMEOUT_MILLIS="
+                    f"{config.fleet_fetch_timeout_millis} must be > 0"
+                )
         return config
 
     def backoff_policy(self):
@@ -1156,4 +1267,21 @@ class Config:
             capacity=self.trace_ring,
             sample_rate=self.trace_sample_rate,
             disk_dir=self.trace_dir,
+        )
+
+    def fleet_config(self):
+        """The fleet membership config (fleet/membership.py), or None
+        when the fleet tier is off (single-replica behavior untouched —
+        resilience_policy() discipline)."""
+        if not self.fleet_self:
+            return None
+        from ..fleet import FleetConfig
+
+        return FleetConfig(
+            self_url=self.fleet_self.rstrip("/"),
+            peers=list(self.fleet_peers),
+            peers_file=self.fleet_peers_file,
+            vnodes=self.fleet_vnodes,
+            lease_millis=self.fleet_lease_millis,
+            fetch_timeout_millis=self.fleet_fetch_timeout_millis,
         )

@@ -581,6 +581,7 @@ def build_app(
     meshfault=None,
     trace_sink=None,
     ledger=None,
+    fleet=None,
 ) -> web.Application:
     metrics = metrics or Metrics()
     register_resilience(metrics, resilience, fault_plan)
@@ -641,8 +642,25 @@ def build_app(
         middlewares.append(admission_middleware(admission))
     if resilience is not None:
         middlewares.append(deadline_middleware(resilience))
+    elif fleet is not None:
+        # fleet peer calls forward their clamped budget as x-deadline-ms
+        # (fleet/client.py); honoring it server-side needs the deadline
+        # stamp even with the resilience subsystem off.  No default
+        # budget — header-only, so non-fleet requests are untouched
+        class _HeaderOnlyDeadline:
+            deadline_ms = 0.0
+
+        middlewares.append(deadline_middleware(_HeaderOnlyDeadline()))
     app = web.Application(middlewares=middlewares)
     app[METRICS_KEY] = metrics
+    if fleet is not None:
+        # the replica-to-replica surface (/fleet/v1/*, fleet/handlers.py)
+        # plus the `fleet` metrics section (membership, leases, peer
+        # fetch and handoff counters)
+        from ..fleet import register_fleet_routes
+
+        register_fleet_routes(app, fleet)
+        metrics.register_provider("fleet", fleet.stats)
     if lifecycle is not None:
         app[LIFECYCLE_KEY] = lifecycle
     if meshfault is not None:

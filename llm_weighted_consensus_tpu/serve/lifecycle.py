@@ -42,6 +42,7 @@ class Lifecycle:
         caches=(),
         watchdog=None,
         meshfault=None,
+        fleet=None,
         drain_timeout_ms: float = 10000.0,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
@@ -57,6 +58,11 @@ class Lifecycle:
         # degraded_mesh flag, never 503, because proportional capacity
         # is still capacity
         self.meshfault = meshfault
+        # fleet coordinator (fleet/): the drain pushes this replica's hot
+        # cache entries to their post-drain owners before the process
+        # exits — a departing replica's hot set survives it
+        self.fleet = fleet
+        self.handoff_entries: Optional[int] = None
         self.drain_timeout_ms = float(drain_timeout_ms)
         self.clock = clock
         self.state = READY
@@ -90,6 +96,19 @@ class Lifecycle:
     async def _drain(self) -> bool:
         t0 = self.clock()
         deadline = t0 + self.drain_timeout_ms / 1e3
+        # 0. fleet hot-set handoff BEFORE readiness flips: the entries
+        #    this replica owns move to their post-drain owners while
+        #    peers can still fetch from us, so a fleet-wide hot key
+        #    stays a cache hit across the departure.  Bounded work
+        #    (HANDOFF_MAX_ENTRIES, per-peer timeouts); any failure is
+        #    skipped — the fleet re-computes what it must
+        if self.fleet is not None:
+            try:
+                self.handoff_entries = await self.fleet.handoff(
+                    self.caches[0] if self.caches else None
+                )
+            except Exception:
+                self.handoff_entries = 0
         # 1. stop admitting BEFORE waiting: readiness flips (the LB
         #    routes away) and the admission gate sheds everything new
         #    with a retryable 503, so the in-flight set only shrinks
@@ -133,6 +152,8 @@ class Lifecycle:
         if self.drained_clean is not None:
             out["drained_clean"] = self.drained_clean
             out["drain_elapsed_ms"] = round(self.drain_elapsed_ms, 1)
+        if self.handoff_entries is not None:
+            out["fleet_handoff_entries"] = self.handoff_entries
         return out
 
 
@@ -159,6 +180,11 @@ def health_handlers(lifecycle: Optional[Lifecycle]):
                 # /metrics section) for the degradation
                 body["degraded_mesh"] = True
                 body["mesh_shape"] = list(mf.current_shape)
+            if lifecycle.fleet is not None:
+                # the balancer-facing view of fleet membership: who this
+                # replica is, the roster it sees, and the key-space share
+                # it currently owns (full counters live in /metrics)
+                body["fleet"] = lifecycle.fleet.membership.snapshot()
             return web.json_response(body)
         return web.json_response(
             {"ready": False, "reason": reason}, status=503

@@ -49,6 +49,7 @@ KNOWN_SECTIONS = (
     "quality",
     "ledger",
     "lock_witness",
+    "fleet",
 )
 
 # Every Prometheus family the text exposition may emit.  Same contract
@@ -69,6 +70,8 @@ KNOWN_PROM_FAMILIES = (
     "lwc_consensus_outcomes",
     "lwc_judge_agreement",
     "lwc_judge_drift",
+    "lwc_fleet_peer_fetches",
+    "lwc_fleet_leases",
 )
 
 
@@ -324,6 +327,27 @@ def render_prometheus(metrics: Metrics) -> str:
         lines.append(
             f'lwc_judge_drift{{judge="{_esc(judge)}"}} {flagged:.0f}'
         )
+
+    fleet = metrics.provider_section("fleet")
+    if isinstance(fleet, dict):
+        fetch = fleet.get("peer_fetch", {})
+        lines += prom_family(
+            "lwc_fleet_peer_fetches",
+            "counter",
+            "Peer cache fetches by result (hit/miss/error).",
+        )
+        for result in ("hits", "misses", "errors"):
+            lines.append(
+                f'lwc_fleet_peer_fetches_total{{result="{result}"}} '
+                f"{fetch.get(result, 0)}"
+            )
+        leases = fleet.get("leases", {})
+        lines += prom_family(
+            "lwc_fleet_leases",
+            "gauge",
+            "Cross-replica single-flight leases active on this owner.",
+        )
+        lines.append(f"lwc_fleet_leases {leases.get('active', 0)}")
 
     lines.append("# EOF")
     return "\n".join(lines) + "\n"

@@ -30,4 +30,13 @@ rc_soak=$?
 # above; named here so "$@" filters can never silently drop it.
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
   -q -k mesh_fault_drill -p no:cacheprovider -p no:xdist -p no:randomly
+rc_mesh=$?
+[ $rc -eq 0 ] && rc=$rc_mesh
+
+# Fleet drill (scripts/fleet_drill.sh): three real replicas sharing a
+# FLEET_PEERS roster + one AOT_CACHE_DIR — a hot fingerprint hits
+# upstream exactly once fleet-wide, a cold replica joins with
+# deserialize-only warmup, and a SIGTERM'd replica hands its hot set to
+# the survivors with zero client errors.
+bash scripts/fleet_drill.sh
 exit $(( rc || $? ))

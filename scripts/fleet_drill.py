@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Three-replica fleet drill (scripts/fleet_drill.sh).
+
+Spawns 3 REAL gateway processes on localhost ports sharing a static
+FLEET_PEERS roster, one counting fake upstream, and one AOT_CACHE_DIR,
+then asserts the fleet acceptance criteria end to end:
+
+1. warm cold start — replica A compiles and serializes its AOT bucket
+   table; replicas B and C, started after, must report
+   ``aot_restored == aot_buckets`` (deserialize-only warmup: zero XLA
+   compiles on join);
+2. hot-key single flight — the SAME score body fired concurrently at
+   all three replicas must reach the upstream judge EXACTLY once
+   (fake-upstream call counter == 1), every response 200;
+3. zero jit growth — serving the scored request must not grow any
+   replica's jit specialization count;
+4. drain handoff — SIGTERM to replica A must exit 0 within the drain
+   timeout, survivors must report ``fleet.handoff.received >= 1``, and
+   requests driven at the survivors during the departure must see zero
+   client errors.
+
+Exit 0 = all assertions held.  Pure localhost + CPU jax; no external
+dependencies beyond the repo's own environment.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DRAIN_TIMEOUT_MS = 10_000
+READY_TIMEOUT_SEC = 240  # replica A pays real XLA compiles on CPU
+# judge latency: the stampede must be a genuine in-flight race, not
+# three sequential cache hits
+UPSTREAM_DELAY_SEC = 0.3
+
+HOT_BODY = json.dumps(
+    {
+        "messages": [{"role": "user", "content": "the hot question"}],
+        "model": {"llms": [{"model": "fake-judge"}]},
+        "choices": ["candidate a", "candidate b"],
+    }
+)
+
+failures = []
+
+
+def check(ok, label):
+    print(f"{'PASS' if ok else 'FAIL'}: {label}")
+    if not ok:
+        failures.append(label)
+
+
+def start_replica(port, peers, fake_port, aot_dir):
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "EMBEDDER_MODEL": "test-tiny",
+            "LWC_ALLOW_RANDOM_PARAMS": "1",
+            "WARMUP": "4x16",
+            "WARMUP_R": "2",
+            "WARMUP_AOT": "1",
+            "AOT_CACHE_DIR": aot_dir,
+            "SCORE_CACHE_TTL": "60",
+            "FLEET_SELF": f"http://127.0.0.1:{port}",
+            "FLEET_PEERS": ",".join(
+                f"http://127.0.0.1:{p}" for p in peers
+            ),
+            "OPENAI_API_BASE": f"http://127.0.0.1:{fake_port}/v1",
+            "OPENAI_API_KEY": "fake-key",
+            "DRAIN_TIMEOUT_MILLIS": str(DRAIN_TIMEOUT_MS),
+        }
+    )
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "llm_weighted_consensus_tpu.serve",
+            "--port",
+            str(port),
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=REPO,
+    )
+
+
+async def start_fake_upstream(port, counter):
+    from aiohttp import web
+
+    from llm_weighted_consensus_tpu.serve.__main__ import _fake_upstream
+
+    async def counting(request):
+        counter["calls"] += 1
+        await asyncio.sleep(UPSTREAM_DELAY_SEC)
+        return await _fake_upstream(request)
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", counting)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    return runner
+
+
+async def wait_ready(session, port, proc):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < READY_TIMEOUT_SEC:
+        if proc.poll() is not None:
+            print(proc.stdout.read())
+            raise RuntimeError(f"replica :{port} died during startup")
+        try:
+            async with session.get(
+                f"http://127.0.0.1:{port}/readyz"
+            ) as resp:
+                if resp.status == 200:
+                    return await resp.json()
+        except Exception:
+            pass
+        await asyncio.sleep(0.25)
+    raise RuntimeError(f"replica :{port} never became ready")
+
+
+async def metrics(session, port):
+    async with session.get(f"http://127.0.0.1:{port}/metrics") as resp:
+        return await resp.json()
+
+
+async def post_hot(session, port):
+    async with session.post(
+        f"http://127.0.0.1:{port}/score/completions",
+        data=HOT_BODY,
+        headers={"content-type": "application/json"},
+    ) as resp:
+        await resp.read()
+        return resp.status
+
+
+async def drill():
+    from aiohttp import ClientSession, ClientTimeout
+    from aiohttp.test_utils import unused_port
+
+    counter = {"calls": 0}
+    fake_port = unused_port()
+    ports = [unused_port() for _ in range(3)]
+    aot_dir = tempfile.mkdtemp(prefix="fleet-drill-aot-")
+    fake_runner = await start_fake_upstream(fake_port, counter)
+    procs = {}
+    try:
+        async with ClientSession(
+            timeout=ClientTimeout(total=60)
+        ) as session:
+            # -- phase 1: warm cold start ------------------------------
+            # A first, alone: it compiles and serializes every bucket
+            procs[ports[0]] = start_replica(
+                ports[0], ports, fake_port, aot_dir
+            )
+            await wait_ready(session, ports[0], procs[ports[0]])
+            jit_a = (await metrics(session, ports[0]))["jit"]
+            check(
+                jit_a["aot_buckets"] > 0 and jit_a["aot_restored"] == 0,
+                f"replica A compiled {jit_a['aot_buckets']} AOT buckets "
+                "from scratch",
+            )
+            # B and C join cold: deserialize-only warmup
+            for port in ports[1:]:
+                procs[port] = start_replica(
+                    port, ports, fake_port, aot_dir
+                )
+            jit_before = {}
+            for port in ports[1:]:
+                body = await wait_ready(session, port, procs[port])
+                check(
+                    body.get("fleet", {}).get("self")
+                    == f"http://127.0.0.1:{port}",
+                    f"replica :{port} /readyz reports fleet membership",
+                )
+                jit = (await metrics(session, port))["jit"]
+                jit_before[port] = jit
+                check(
+                    jit["aot_restored"] == jit["aot_buckets"]
+                    and jit["aot_buckets"] == jit_a["aot_buckets"],
+                    f"replica :{port} cold start restored "
+                    f"{jit['aot_restored']}/{jit['aot_buckets']} buckets "
+                    "(zero compiles)",
+                )
+
+            # -- phase 2: hot-key stampede -----------------------------
+            before = counter["calls"]
+            statuses = await asyncio.gather(
+                *(post_hot(session, port) for port in ports)
+            )
+            check(
+                all(s == 200 for s in statuses),
+                f"hot key served 200 on all replicas: {statuses}",
+            )
+            check(
+                counter["calls"] - before == 1,
+                "hot fingerprint hit upstream exactly once fleet-wide "
+                f"(calls={counter['calls'] - before})",
+            )
+
+            # -- phase 3: zero jit growth while serving ----------------
+            for port in ports[1:]:
+                jit = (await metrics(session, port))["jit"]
+                check(
+                    jit["specializations"]
+                    == jit_before[port]["specializations"],
+                    f"replica :{port} served with zero new jit "
+                    "specializations",
+                )
+
+            # -- phase 4: SIGTERM + handoff ----------------------------
+            victim = procs.pop(ports[0])
+            victim.send_signal(signal.SIGTERM)
+            # the departure must be invisible to clients: keep driving
+            # the survivors while A drains
+            statuses = []
+            for _ in range(5):
+                statuses += await asyncio.gather(
+                    *(post_hot(session, port) for port in ports[1:])
+                )
+            check(
+                all(s == 200 for s in statuses),
+                "zero client errors on survivors during the departure",
+            )
+            rc = victim.wait(timeout=DRAIN_TIMEOUT_MS / 1000 + 10)
+            check(rc == 0, f"SIGTERM'd replica exited clean (rc={rc})")
+            received = 0
+            for port in ports[1:]:
+                received += (await metrics(session, port))["fleet"][
+                    "handoff"
+                ]["received"]
+            check(
+                received >= 1,
+                f"survivors accepted the departing hot set "
+                f"(handoff received={received})",
+            )
+    finally:
+        for proc in procs.values():
+            proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=DRAIN_TIMEOUT_MS / 1000 + 10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        await fake_runner.cleanup()
+
+
+def main():
+    asyncio.new_event_loop().run_until_complete(drill())
+    if failures:
+        print(f"\nfleet drill FAILED ({len(failures)} assertion(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\nfleet drill PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Fleet drill — three real gateway replicas on localhost ports sharing a
+# FLEET_PEERS roster, a counting fake upstream, and one AOT_CACHE_DIR
+# (scripts/fleet_drill.py).  Proves the fleet acceptance end to end:
+# a hot fingerprint hits upstream exactly once fleet-wide, a cold
+# replica joins with deserialize-only (zero-compile) warmup, and a
+# SIGTERM'd replica hands its hot set to the survivors with zero client
+# errors.  Kept OUT of tier-1 (multi-process, wall-clock heavy); runs
+# as a named step next to chaos.sh.  Run from the repo root.
+set -o pipefail
+timeout -k 10 900 env JAX_PLATFORMS=cpu python scripts/fleet_drill.py "$@"

@@ -1475,9 +1475,200 @@ async def bench_fleet(args) -> None:
             await runner.cleanup()
 
 
+async def bench_fleet_partition(args) -> None:
+    """Degraded-goodput under a network partition (fleet/faults.py):
+    the same three-replica fleet as ``--fleet``, but a second trio is
+    started with ``FLEET_FAULT_PLAN`` env specs carving a ``{a} | {b,c}``
+    cut out of pure configuration (``blackhole=1.0,to=...`` per
+    replica).  Cold populates, warm rotates every fingerprint onto a
+    different replica — under the cut, cross-partition peer fetches
+    blackhole, breakers open, quarantine re-homes the severed keys, and
+    every request still answers 200 with clean frames from local
+    compute.  Acceptance: zero errors and zero degraded frames in the
+    partitioned warm round, warm upstream == 0 when healthy."""
+    import os
+
+    import aiohttp
+    from aiohttp import web
+    from aiohttp.test_utils import unused_port
+
+    from llm_weighted_consensus_tpu.serve import Config
+    from llm_weighted_consensus_tpu.serve.__main__ import (
+        _fake_upstream,
+        build_service,
+    )
+
+    os.environ.setdefault("FAKE_UPSTREAM_DELAY_MS", "25")
+    concurrency = min(args.concurrency, 8)
+    requests = min(args.requests, 60)
+
+    calls = {"n": 0}
+
+    async def counting_upstream(request):
+        calls["n"] += 1
+        return await _fake_upstream(request)
+
+    fake_port = unused_port()
+    fake_app = web.Application()
+    fake_app.router.add_post("/v1/chat/completions", counting_upstream)
+    fake_runner = web.AppRunner(fake_app)
+    await fake_runner.setup()
+    await web.TCPSite(fake_runner, "127.0.0.1", fake_port).start()
+
+    rng = np.random.default_rng(17)
+    bodies = []
+    for i in range(requests):
+        words = " ".join(rng.choice(BENCH_WORDS, size=24).tolist())
+        bodies.append(
+            json.dumps(
+                {
+                    "stream": True,
+                    "messages": [{"role": "user", "content": words}],
+                    "model": {"llms": [{"model": "fake-judge"}]},
+                    "choices": [f"candidate a {i}", f"candidate b {i}"],
+                }
+            )
+        )
+
+    async def start_trio(fault_plan_for):
+        ports = [unused_port() for _ in range(3)]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        trio_runners, bases = [], []
+        for i, port in enumerate(ports):
+            env = {
+                "EMBEDDER_MODEL": "",
+                "SCORE_CACHE_TTL": "600",
+                "FLEET_SELF": urls[i],
+                "FLEET_PEERS": ",".join(urls),
+                # bound the blackhole burn so degraded goodput reads the
+                # breaker/quarantine recovery, not a 2 s default timeout
+                "FLEET_FETCH_TIMEOUT_MILLIS": "150",
+                "OPENAI_API_BASE": f"http://127.0.0.1:{fake_port}/v1",
+                "OPENAI_API_KEY": "bench-key",
+            }
+            plan = fault_plan_for(i, urls)
+            if plan:
+                env["FLEET_FAULT_PLAN"] = plan
+            runner = web.AppRunner(build_service(Config.from_env(env)))
+            await runner.setup()
+            await web.TCPSite(runner, "127.0.0.1", port).start()
+            trio_runners.append(runner)
+            bases.append(urls[i])
+        return trio_runners, bases
+
+    async def drive(session, bases):
+        """cold (populate) then warm (rotated) rounds; returns the warm
+        phase dict + violation count."""
+        bad = {"n": 0}
+
+        async def round_at(offset):
+            sem = asyncio.Semaphore(concurrency)
+            lat = []
+
+            async def one(i, body):
+                async with sem:
+                    t0 = time.perf_counter()
+                    async with session.post(
+                        bases[(i + offset) % 3] + "/score/completions",
+                        data=body,
+                    ) as resp:
+                        payload = await resp.read()
+                        assert resp.status == 200, payload[:200]
+                        if (
+                            b'"degraded":true' in payload
+                            or b"corrupt" in payload
+                        ):
+                            bad["n"] += 1
+                    lat.append((time.perf_counter() - t0) * 1e3)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(one(i, b) for i, b in enumerate(bodies))
+            )
+            return time.perf_counter() - t0, lat
+
+        c0 = calls["n"]
+        await round_at(0)
+        cold_upstream = calls["n"] - c0
+        await asyncio.sleep(0.3)  # publishes land
+        c0 = calls["n"]
+        total, lat = await round_at(1)
+        return {
+            "rps": round(len(lat) / total, 2),
+            **_percentiles(lat),
+            "upstream_calls": calls["n"] - c0,
+            "cold_upstream_calls": cold_upstream,
+            "dirty_frames": bad["n"],
+        }
+
+    def healthy_plan(i, urls):
+        return None
+
+    def partition_plan(i, urls):
+        # {urls[0]} | {urls[1], urls[2]}, carved from env config alone
+        if i == 0:
+            return f"blackhole=1.0,to={urls[1]}|{urls[2]}"
+        return f"blackhole=1.0,to={urls[0]}"
+
+    runners = [fake_runner]
+    try:
+        async with aiohttp.ClientSession(
+            headers={"content-type": "application/json"}
+        ) as session:
+            trio, bases = await start_trio(healthy_plan)
+            runners += trio
+            healthy = await drive(session, bases)
+
+            trio, bases = await start_trio(partition_plan)
+            runners += trio
+            partitioned = await drive(session, bases)
+
+            fleet_counters = []
+            for base in bases:
+                async with session.get(base + "/metrics") as resp:
+                    fleet_counters.append(
+                        (await resp.json()).get("fleet", {})
+                    )
+
+        emit(
+            "/score/completions?fleet-partition",
+            partitioned["rps"],
+            "requests/sec degraded goodput (warm round under partition)",
+            requests=len(bodies),
+            concurrency=concurrency,
+            replicas=3,
+            healthy_warm=healthy,
+            partitioned_warm=partitioned,
+            local_fallbacks=sum(
+                c.get("local_fallbacks", 0) for c in fleet_counters
+            ),
+            peer_errors=sum(
+                c.get("peer_fetch", {}).get("errors", 0)
+                for c in fleet_counters
+            ),
+            quarantines=sum(
+                c.get("health", {}).get("quarantines", 0)
+                for c in fleet_counters
+            ),
+            note=(
+                "3 replicas; partition carved via FLEET_FAULT_PLAN "
+                "blackhole=1.0,to=... env specs ({a} | {b,c}); "
+                "acceptance = healthy warm upstream_calls == 0, "
+                "partitioned warm all-200 with dirty_frames == 0 "
+                "(severed replicas recompute locally, clean)"
+            ),
+        )
+    finally:
+        for runner in runners:
+            await runner.cleanup()
+
+
 async def main_async(args) -> None:
     import aiohttp
 
+    if args.fleet_partition:
+        await bench_fleet_partition(args)
+        return
     if args.trace_overhead:
         await bench_trace_overhead(args)
         return
@@ -1645,6 +1836,15 @@ def main() -> None:
         "3 replicas sharing a FLEET_PEERS roster + one counting fake "
         "upstream; cold / warm (peer-fetch) / hot-key-stampede goodput; "
         "acceptance = warm upstream_calls 0, stampede upstream_calls 1",
+    )
+    parser.add_argument(
+        "--fleet-partition",
+        action="store_true",
+        help="run the fleet-partition scenario instead of the endpoint "
+        "trio: the --fleet trio healthy vs. a second trio with a "
+        "{a} | {b,c} cut carved via FLEET_FAULT_PLAN env specs; "
+        "reports degraded warm goodput under the partition; acceptance "
+        "= all-200 with zero degraded frames both ways",
     )
     parser.add_argument("--n", type=int, default=64)
     parser.add_argument("--requests", type=int, default=100)

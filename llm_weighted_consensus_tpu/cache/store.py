@@ -59,6 +59,7 @@ class CacheStore:
         self.expirations = 0
         self.puts = 0
         self.disk_loaded = 0
+        self.disk_torn = 0
         self.flushes = 0
         if self.enabled and disk_dir:
             self._load_disk(disk_dir)
@@ -161,6 +162,7 @@ class CacheStore:
             "evictions": self.evictions,
             "expirations": self.expirations,
             "disk_loaded": self.disk_loaded,
+            "disk_torn": self.disk_torn,
             "flushes": self.flushes,
         }
 
@@ -261,7 +263,12 @@ class CacheStore:
                                 float(rec["e"]),
                             )
                         except (ValueError, KeyError, TypeError):
-                            continue  # torn tail write / foreign line
+                            # torn tail write (kill -9 mid-append) or a
+                            # foreign line: skipped and COUNTED — a torn
+                            # record is expected crash debris, not a
+                            # reason to fail the whole segment load
+                            self.disk_torn += 1
+                            continue
             except OSError:
                 continue
         for fp, (value, size, expires_at) in loaded.items():

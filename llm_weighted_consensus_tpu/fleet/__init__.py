@@ -21,7 +21,12 @@ NSDI'13):
 * ``handlers``    — the ``/fleet/v1/*`` aiohttp handlers, including the
   wire-side replay admission guard (a peer can never be served a
   degraded or errored record);
-* ``wire``        — record validation shared by publish and handoff.
+* ``wire``        — record validation shared by publish and handoff;
+* ``faults``      — the ``FLEET_FAULT_PLAN`` seam: deterministic
+  per-peer-pair fault injection (partitions, flaps, corruption) so
+  every failure path above is drillable from a seed;
+* ``health``      — peer quarantine: a flapping peer is ejected from
+  the routing ring behind a health score and re-admitted by probe.
 
 Everything here is single-event-loop asyncio: no threading primitives,
 so the concurrency-model registry (analysis/concurrency_model.py) gains
@@ -30,17 +35,23 @@ no rows and the lockdep witness has nothing new to watch.
 
 from .client import FleetClient
 from .coordinator import FleetCoordinator
+from .faults import FleetFaultPlan
 from .handlers import register_fleet_routes
+from .health import PeerHealth
 from .leases import LeaseTable
-from .membership import FleetConfig, FleetMembership
-from .wire import clean_chunk_objs
+from .membership import FleetConfig, FleetMembership, OwnershipView
+from .wire import clean_chunk_objs, record_digest
 
 __all__ = [
     "FleetClient",
     "FleetConfig",
     "FleetCoordinator",
+    "FleetFaultPlan",
     "FleetMembership",
     "LeaseTable",
+    "OwnershipView",
+    "PeerHealth",
     "clean_chunk_objs",
+    "record_digest",
     "register_fleet_routes",
 ]

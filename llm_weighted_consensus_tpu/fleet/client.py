@@ -27,6 +27,7 @@ from ..obs import propagate
 from ..resilience.breaker import BreakerConfig, BreakerRegistry
 from ..resilience.deadline import current_deadline
 from ..utils import jsonutil
+from . import faults as fleet_faults
 from .wire import clean_chunk_objs
 
 # a peer leg may consume at most this fraction of the remaining request
@@ -47,13 +48,21 @@ class FleetClient:
         self_url: str,
         *,
         fetch_timeout_ms: float = 2000.0,
+        fault_plan=None,
     ) -> None:
         self.self_url = self_url
         self.fetch_timeout_ms = fetch_timeout_ms
         self.breakers = BreakerRegistry(_BREAKER)
+        # FLEET_FAULT_PLAN seam (fleet/faults.py); None — the default —
+        # costs one identity check per request
+        self.fault_plan = fault_plan
+        # transport-outcome listener (fleet/health.py PeerHealth),
+        # installed by the coordinator when quarantine is enabled
+        self.health = None
         self._session = None
         self.peer_errors = 0
         self.deadline_sheds = 0
+        self.peer_5xx = 0
 
     # -- plumbing -------------------------------------------------------------
 
@@ -83,6 +92,10 @@ class FleetClient:
             budget = min(budget, remaining_ms * DEADLINE_SHARE)
         return max(1.0, budget)
 
+    def _health(self, peer: str, ok: bool) -> None:
+        if self.health is not None:
+            self.health.record(peer, ok)
+
     async def _request(
         self,
         method: str,
@@ -91,6 +104,7 @@ class FleetClient:
         *,
         body: Optional[dict] = None,
         extra_ms: float = 0.0,
+        ring: Optional[str] = None,
     ):
         """(status, json_obj) or None on any transport-level failure
         (breaker open, deadline spent, connect/read error, timeout)."""
@@ -108,10 +122,48 @@ class FleetClient:
             import aiohttp
             import asyncio
 
+            fault = None
+            if self.fault_plan is not None:
+                fault = self.fault_plan.next_fault(self.self_url, peer)
+            if fault in (fleet_faults.CONNECT, fleet_faults.FLAP):
+                # connection refused — immediate, no budget burned
+                self.peer_errors += 1
+                breaker.record_failure()
+                self._health(peer, False)
+                resolved = True
+                return None
+            if fault == fleet_faults.BLACKHOLE:
+                # packets vanish: the leg rides its whole clamped
+                # budget before timing out, like a real partition
+                await asyncio.sleep(budget_ms / 1000.0)
+                self.peer_errors += 1
+                breaker.record_failure()
+                self._health(peer, False)
+                resolved = True
+                return None
+            if fault == fleet_faults.BAD_STATUS:
+                # the peer answered (transport healthy) but with a 503
+                self.peer_5xx += 1
+                breaker.record_failure()
+                self._health(peer, True)
+                resolved = True
+                return 503, {
+                    "error": {
+                        "kind": "fault_injected",
+                        "message": "fleet fault-injected 503",
+                    }
+                }
+            if fault == fleet_faults.SLOW:
+                await asyncio.sleep(
+                    min(self.fault_plan.slow_ms, budget_ms) / 1000.0
+                )
+
             headers = {
                 "content-type": "application/json",
                 "x-deadline-ms": str(int(budget_ms)),
             }
+            if ring is not None:
+                headers["x-fleet-ring"] = ring
             propagate.inject(headers)
             session = self._ensure_session()
             try:
@@ -131,12 +183,22 @@ class FleetClient:
                         payload = jsonutil.loads(await resp.read())
                     else:
                         await resp.read()
-                    breaker.record_success()
+                    if fault == fleet_faults.CORRUPT:
+                        payload = fleet_faults.corrupt_payload(payload)
+                    if resp.status >= 500:
+                        # a peer stuck returning 5xx is as unavailable
+                        # as a dead one: the breaker must open
+                        self.peer_5xx += 1
+                        breaker.record_failure()
+                    else:
+                        breaker.record_success()
+                    self._health(peer, True)
                     resolved = True
                     return resp.status, payload
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 self.peer_errors += 1
                 breaker.record_failure()
+                self._health(peer, False)
                 resolved = True
                 return None
         finally:
@@ -146,16 +208,19 @@ class FleetClient:
     # -- the peer protocol ----------------------------------------------------
 
     async def fetch_entry(
-        self, owner: str, fp: str, *, wait_ms: float = 0.0
+        self, owner: str, fp: str, *, wait_ms: float = 0.0,
+        ring: Optional[str] = None,
     ) -> Tuple[str, Optional[list]]:
-        """("hit", chunks) | ("miss", None) | ("error", None).  With
-        ``wait_ms`` the owner long-polls its lease table before
-        answering, so a waiter gets the published entry in one trip."""
+        """("hit", chunks) | ("miss", None) | ("divergent", None) |
+        ("error", None).  With ``wait_ms`` the owner long-polls its
+        lease table before answering, so a waiter gets the published
+        entry in one trip.  "divergent" means the peer rejected our
+        ``x-fleet-ring`` digest: it is routing on a different roster."""
         path = f"/fleet/v1/entry/{fp}"
         if wait_ms > 0:
             path += f"?wait_ms={int(wait_ms)}"
         result = await self._request(
-            "GET", owner, path, extra_ms=wait_ms
+            "GET", owner, path, extra_ms=wait_ms, ring=ring
         )
         if result is None:
             return "error", None
@@ -167,19 +232,26 @@ class FleetClient:
             return "error", None
         if status == 404:
             return "miss", None
+        if status == 409:
+            return "divergent", None
         return "error", None
 
-    async def request_lease(self, owner: str, fp: str) -> str:
-        """"granted" | "wait" | "error"."""
+    async def request_lease(
+        self, owner: str, fp: str, *, ring: Optional[str] = None
+    ) -> str:
+        """"granted" | "wait" | "divergent" | "error"."""
         result = await self._request(
             "POST", owner, f"/fleet/v1/lease/{fp}",
             body={"holder": self.self_url},
+            ring=ring,
         )
         if result is None:
             return "error"
         status, payload = result
         if status == 200 and isinstance(payload, dict):
             return "granted" if payload.get("granted") else "wait"
+        if status == 409:
+            return "divergent"
         return "error"
 
     async def release_lease(self, owner: str, fp: str) -> None:
@@ -210,9 +282,43 @@ class FleetClient:
             return int(payload.get("accepted", 0))
         return 0
 
+    async def probe(self, peer: str) -> bool:
+        """One liveness GET against a quarantined peer.  Deliberately
+        BYPASSES the breaker gate (a quarantined peer's breaker is
+        usually open — that is exactly why traffic stopped and a probe
+        must go instead) but still rides the fault seam, so a scripted
+        partition fails probes deterministically too."""
+        if self.fault_plan is not None:
+            fault = self.fault_plan.next_fault(self.self_url, peer)
+            if fault in (
+                fleet_faults.CONNECT,
+                fleet_faults.FLAP,
+                fleet_faults.BLACKHOLE,
+                fleet_faults.BAD_STATUS,
+            ):
+                return False
+        import aiohttp
+        import asyncio
+
+        try:
+            async with self._ensure_session().get(
+                peer + "/fleet/v1/ping",
+                timeout=aiohttp.ClientTimeout(
+                    total=self.fetch_timeout_ms / 1000.0
+                ),
+            ) as resp:
+                await resp.read()
+                return resp.status < 500
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return False
+
     def stats(self) -> dict:
-        return {
+        out = {
             "peer_errors": self.peer_errors,
+            "peer_5xx": self.peer_5xx,
             "deadline_sheds": self.deadline_sheds,
             "breakers": self.breakers.snapshot(),
         }
+        if self.fault_plan is not None:
+            out["fault_plan"] = self.fault_plan.snapshot()
+        return out

@@ -19,6 +19,13 @@ propagated deadline rather than occupying an admission slot):
   set; every entry passes the same wire guard, and the remaining TTL
   rides along so a handed-off entry can never outlive its original
   lifetime.
+* ``GET    /fleet/v1/ping``        — liveness, for quarantine
+  re-admission probes.
+
+Entry GET and lease POST additionally check the caller's
+``x-fleet-ring`` digest against the local roster and answer 409 on a
+mismatch — a split-brain caller must degrade to local, not be handed a
+lease decision made on a different ring.
 """
 
 from __future__ import annotations
@@ -53,7 +60,39 @@ async def _read_body(request) -> dict:
 def register_fleet_routes(app, fleet) -> None:
     """Wire the fleet peer endpoints onto the gateway app."""
 
+    def _ring_mismatch(request):
+        """A 409 when the caller's ``x-fleet-ring`` digest disagrees
+        with ours — the two replicas are routing on different rosters.
+        Enforced only on the calls that CAUSE duplicate upstream work
+        when misrouted (entry fetch, lease claim); publish, release and
+        handoff stay digest-blind on purpose: a draining or lagging
+        replica's results and abandons are still valid, and rejecting an
+        abandon would strand waiters until TTL."""
+        claimed = request.headers.get("x-fleet-ring")
+        if claimed is None:
+            return None
+        ours = fleet.membership.ring_digest()
+        if claimed == ours:
+            return None
+        fleet.ring_rejects += 1
+        return _json(
+            {
+                "kind": "ring_divergence",
+                "ring": ours,
+                "epoch": fleet.membership.epoch,
+            },
+            status=409,
+        )
+
+    async def ping(request):
+        # the quarantine re-admission probe target: answering at all is
+        # the signal, the body is a courtesy
+        return _json({"ok": True, "self": fleet.membership.self_url})
+
     async def entry_get(request):
+        mismatch = _ring_mismatch(request)
+        if mismatch is not None:
+            return mismatch
         fp = request.match_info["fp"]
         cache = fleet.cache
         record = cache.get(fp) if cache is not None else None
@@ -92,10 +131,17 @@ def register_fleet_routes(app, fleet) -> None:
             return _json({"accepted": False}, status=422)
         if fleet.cache is not None:
             fleet.cache.put_chunks(fp, chunks)
-        fleet.leases.publish(fp)
-        return _json({"accepted": True})
+        # holder-aware retire: a LATE publish (lease expired or stolen
+        # while the holder was partitioned away) still lands in the
+        # cache — the work is done, wasting it helps no one — but must
+        # not tear down the CURRENT claimant's lease
+        retired = fleet.leases.publish(fp, holder if holder else None)
+        return _json({"accepted": True, "retired": retired})
 
     async def lease_post(request):
+        mismatch = _ring_mismatch(request)
+        if mismatch is not None:
+            return mismatch
         fp = request.match_info["fp"]
         body = await _read_body(request)
         holder = str(body.get("holder", "")) or "unknown-peer"
@@ -142,6 +188,7 @@ def register_fleet_routes(app, fleet) -> None:
         fleet.handoff_received += accepted
         return _json({"accepted": accepted})
 
+    app.router.add_get("/fleet/v1/ping", ping)
     app.router.add_get("/fleet/v1/entry/{fp}", entry_get)
     app.router.add_put("/fleet/v1/entry/{fp}", entry_put)
     app.router.add_post("/fleet/v1/lease/{fp}", lease_post)

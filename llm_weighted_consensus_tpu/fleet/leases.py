@@ -39,6 +39,8 @@ class LeaseTable:
         self.published = 0
         self.released = 0
         self.expirations = 0
+        self.takeovers = 0
+        self.late_publishes = 0
 
     def _expire(self, fp: str, lease: list) -> None:
         del self._leases[fp]
@@ -65,10 +67,41 @@ class LeaseTable:
                 return True, None
             self.waits += 1
             return False, lease[2]
-        future = asyncio.get_event_loop().create_future()
+        future = asyncio.get_running_loop().create_future()
         self._leases[fp] = [holder, now + self.ttl_sec, future]
         self.granted += 1
         return True, None
+
+    def holder(self, fp: str) -> Optional[str]:
+        """The live lease's holder URL (lazily expiring), or None."""
+        lease = self._leases.get(fp)
+        if lease is None:
+            return None
+        if lease[1] <= self.clock():
+            self._expire(fp, lease)
+            return None
+        return lease[0]
+
+    def steal(self, fp: str, new_holder: str) -> bool:
+        """Early takeover: the current holder is believed dead (its
+        breaker is open or a liveness probe failed), so the lease moves
+        to ``new_holder`` without waiting out the TTL.  Old waiters wake
+        to None (fall back to local compute — the safe direction); the
+        thief gets a fresh TTL.  False when no live lease exists or
+        ``new_holder`` already holds it."""
+        lease = self._leases.get(fp)
+        now = self.clock()
+        if lease is not None and lease[1] <= now:
+            self._expire(fp, lease)
+            lease = None
+        if lease is None or lease[0] == new_holder:
+            return False
+        if not lease[2].done():
+            lease[2].set_result(None)
+        future = asyncio.get_running_loop().create_future()
+        self._leases[fp] = [new_holder, now + self.ttl_sec, future]
+        self.takeovers += 1
+        return True
 
     def holder_future(self, fp: str) -> Optional[asyncio.Future]:
         """The active lease's publish future (long-poll handlers wait on
@@ -87,13 +120,30 @@ class LeaseTable:
             return 0.0
         return max(0.0, lease[1] - self.clock())
 
-    def publish(self, fp: str) -> None:
+    def publish(self, fp: str, holder: Optional[str] = None) -> bool:
         """The holder's result landed (in the owner's cache): wake every
-        waiter with success and retire the lease."""
-        lease = self._leases.pop(fp, None)
+        waiter with success and retire the lease.
+
+        With ``holder`` given, the retire is holder-checked: a LATE
+        publish — the lease already expired (or was stolen and
+        re-granted to someone else) — must not tear down the *current*
+        claimant's lease.  The late result is still worth caching (the
+        caller already put it), so it is counted as a reconciliation
+        rather than dropped; returns False so the caller can tell.
+        ``holder=None`` keeps the legacy unconditional retire (the
+        local-owner path, where the process itself held the slot)."""
+        lease = self._leases.get(fp)
+        if lease is not None and lease[1] <= self.clock():
+            self._expire(fp, lease)
+            lease = None
+        if holder is not None and (lease is None or lease[0] != holder):
+            self.late_publishes += 1
+            return False
+        self._leases.pop(fp, None)
         self.published += 1
         if lease is not None and not lease[2].done():
             lease[2].set_result(True)
+        return True
 
     def release(self, fp: str, holder: str) -> None:
         """The holder abandons without a result (its upstream fan-out
@@ -131,5 +181,7 @@ class LeaseTable:
             "published": self.published,
             "released": self.released,
             "expirations": self.expirations,
+            "takeovers": self.takeovers,
+            "late_publishes": self.late_publishes,
             "ttl_sec": self.ttl_sec,
         }

@@ -10,6 +10,26 @@ clockwise from its own hash, and adding or removing one peer moves
 only the keys that peer owned — the property that makes elastic
 scale-out and the drain-time hot-set handoff cheap.
 
+Ownership is consumed through immutable ``OwnershipView`` snapshots,
+stamped with a **ring epoch** (monotonic per process, bumped on every
+ring rebuild) and a **ring digest** (xxh3 over the sorted roster — the
+cross-process fingerprint two replicas can compare).  A request pins
+ONE view at ``begin`` and routes every later leg (lease, publish,
+abandon) through it, so a roster reload mid-request cannot misroute
+the publish to a different "owner" than the one holding the lease.
+The digest rides every peer call as ``x-fleet-ring``; a mismatch means
+the two replicas are operating on different rosters (split-brain from
+staggered peers-file reads) and the caller degrades to local instead
+of silently double-hitting upstream.
+
+Quarantine (fleet/health.py decides *who*) removes a sick peer from
+the ACTIVE ring — ownership is recomputed without it, so a flapping
+replica costs one probe instead of a timeout per request.  The digest
+deliberately covers only the configured roster, never the quarantine
+set: quarantine is local knowledge, and folding it into the digest
+would make every replica's ring look divergent the moment one of them
+noticed a sick peer.
+
 Hashing is xxh3 (the identity layer's function family), NOT Python's
 ``hash()``: ring positions must agree across processes, and ``hash()``
 is salted per process by PYTHONHASHSEED.
@@ -21,7 +41,7 @@ import bisect
 import os
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import xxhash
 
@@ -40,6 +60,51 @@ class FleetConfig:
     vnodes: int = 64
     lease_millis: float = 10000.0
     fetch_timeout_millis: float = 2000.0
+    fault_plan_spec: Optional[str] = None
+    quarantine_failures: int = 3
+    probe_millis: float = 1000.0
+
+
+class OwnershipView:
+    """One immutable ring snapshot: every routing decision a single
+    request makes (owner at begin, publish target, abandon target) must
+    come from the SAME view, so membership churn between those calls
+    cannot split them across two rosters."""
+
+    __slots__ = ("self_url", "peers", "epoch", "digest", "_points", "_owners")
+
+    def __init__(
+        self,
+        self_url: str,
+        peers: Tuple[str, ...],
+        epoch: int,
+        digest: str,
+        vnodes: int,
+    ) -> None:
+        self.self_url = self_url
+        self.peers = peers
+        self.epoch = epoch
+        self.digest = digest
+        points = []
+        for peer in peers:
+            for i in range(max(1, vnodes)):
+                points.append((_point(f"{peer}#{i}"), peer))
+        points.sort()
+        self._points = [h for h, _ in points]
+        self._owners = [p for _, p in points]
+
+    def owner(self, fp: str) -> Optional[str]:
+        """The replica owning ``fp`` in this snapshot, or None with an
+        empty ring."""
+        if not self._points:
+            return None
+        i = bisect.bisect_right(self._points, _point(fp))
+        if i == len(self._points):
+            i = 0
+        return self._owners[i]
+
+    def owns(self, fp: str) -> bool:
+        return self.owner(fp) == self.self_url
 
 
 class FleetMembership:
@@ -59,8 +124,12 @@ class FleetMembership:
         self.self_url = config.self_url.rstrip("/")
         self.clock = clock
         self.reloads = 0
+        self.epoch = 0
         self._file_mtime: Optional[float] = None
         self._last_check = 0.0
+        self._quarantined: set = set()
+        self._view: Optional[OwnershipView] = None
+        self._departure_view: Optional[OwnershipView] = None
         peers = list(config.peers)
         if config.peers_file:
             loaded = self._read_peers_file()
@@ -91,13 +160,62 @@ class FleetMembership:
 
     def _set_peers(self, peers: List[str]) -> None:
         self._peers = sorted({p.rstrip("/") for p in peers if p})
-        points = []
-        for peer in self._peers:
-            for i in range(max(1, self.config.vnodes)):
-                points.append((_point(f"{peer}#{i}"), peer))
-        points.sort()
-        self._ring_points = [h for h, _ in points]
-        self._ring_peers = [p for _, p in points]
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        """Recompute the active ring (roster minus quarantined peers —
+        never this replica itself) and bump the epoch.  Views are
+        rebuilt lazily: a rebuild between two requests costs nothing
+        until someone routes."""
+        self.epoch += 1
+        self._view = None
+        self._departure_view = None
+
+    def ring_digest(self) -> str:
+        """The cross-process roster fingerprint: two replicas reading
+        the same peers file agree on it byte-for-byte.  Covers the
+        CONFIGURED roster only (see module docstring on quarantine)."""
+        label = "|".join(self._peers) + f"#v{self.config.vnodes}"
+        return xxhash.xxh3_64_hexdigest(label.encode("utf-8"))
+
+    def _active_peers(self) -> Tuple[str, ...]:
+        return tuple(
+            p
+            for p in self._peers
+            if p == self.self_url or p not in self._quarantined
+        )
+
+    def view(self) -> OwnershipView:
+        """The current pinned-ownership snapshot (cached until the next
+        ring rebuild)."""
+        self._maybe_reload()
+        if self._view is None:
+            self._view = OwnershipView(
+                self.self_url,
+                self._active_peers(),
+                self.epoch,
+                self.ring_digest(),
+                self.config.vnodes,
+            )
+        return self._view
+
+    def departure_view(self) -> OwnershipView:
+        """The ring as it looks once this replica leaves — the
+        drain-time handoff router, built ONCE per rebuild instead of
+        the old O(peers×vnodes) scan per entry."""
+        self._maybe_reload()
+        if self._departure_view is None:
+            peers = tuple(
+                p for p in self._active_peers() if p != self.self_url
+            )
+            self._departure_view = OwnershipView(
+                self.self_url,
+                peers,
+                self.epoch,
+                self.ring_digest(),
+                self.config.vnodes,
+            )
+        return self._departure_view
 
     def _maybe_reload(self) -> None:
         if not self.config.peers_file:
@@ -122,17 +240,26 @@ class FleetMembership:
         self._maybe_reload()
         return list(self._peers)
 
+    # -- quarantine -----------------------------------------------------------
+
+    def set_quarantined(self, sick) -> None:
+        """Install the health layer's verdict.  Only a CHANGE rebuilds
+        the ring (and bumps the epoch) — steady state is a set compare."""
+        sick = {s.rstrip("/") for s in sick}
+        sick.discard(self.self_url)
+        if sick == self._quarantined:
+            return
+        self._quarantined = sick
+        self._rebuild()
+
+    def quarantined(self) -> List[str]:
+        return sorted(self._quarantined)
+
     # -- ownership ------------------------------------------------------------
 
     def owner(self, fp: str) -> Optional[str]:
         """The replica owning ``fp``, or None with an empty ring."""
-        self._maybe_reload()
-        if not self._ring_points:
-            return None
-        i = bisect.bisect_right(self._ring_points, _point(fp))
-        if i == len(self._ring_points):
-            i = 0
-        return self._ring_peers[i]
+        return self.view().owner(fp)
 
     def owns(self, fp: str) -> bool:
         return self.owner(fp) == self.self_url
@@ -140,31 +267,18 @@ class FleetMembership:
     def owner_excluding_self(self, fp: str) -> Optional[str]:
         """Where ``fp`` lands once this replica leaves the ring — the
         drain-time handoff target.  None when no other peer exists."""
-        self._maybe_reload()
-        others = [p for p in self._peers if p != self.self_url]
-        if not others:
-            return None
-        if len(others) == len(self._peers):
-            return self.owner(fp)
-        h = _point(fp)
-        best = None
-        for peer in others:
-            for i in range(max(1, self.config.vnodes)):
-                ph = _point(f"{peer}#{i}")
-                d = (ph - h) % (1 << 64)
-                if best is None or d < best[0]:
-                    best = (d, peer)
-        return best[1]
+        return self.departure_view().owner(fp)
 
     def owned_share(self, samples: int = 256) -> float:
         """Estimated fraction of the key space this replica owns
         (deterministic probe points; surfaced in /readyz + metrics)."""
-        if not self._ring_points:
+        view = self.view()
+        if not view._points:
             return 0.0
         owned = sum(
             1
             for i in range(samples)
-            if self.owner(f"fleet-share-probe:{i}") == self.self_url
+            if view.owner(f"fleet-share-probe:{i}") == self.self_url
         )
         return owned / float(samples)
 
@@ -175,4 +289,7 @@ class FleetMembership:
             "owned_share": round(self.owned_share(), 4),
             "vnodes": self.config.vnodes,
             "roster_reloads": self.reloads,
+            "epoch": self.epoch,
+            "ring": self.ring_digest(),
+            "quarantined": self.quarantined(),
         }

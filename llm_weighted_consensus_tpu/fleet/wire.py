@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import xxhash
+
 from ..cache.replay import chunks_from_record
 
 
@@ -34,3 +36,15 @@ def clean_chunk_objs(chunk_objs) -> Optional[List[dict]]:
         if any(c.error is not None for c in chunk.choices):
             return None
     return chunk_objs
+
+
+def record_digest(chunk_objs: List[dict]) -> str:
+    """A stable xxh3 fingerprint of a chunk record's canonical JSON —
+    what the partition drill compares to assert two replicas converged
+    on byte-identical content (and that a replay from the same seed
+    reproduced it)."""
+    from ..utils import jsonutil
+
+    return xxhash.xxh3_64_hexdigest(
+        jsonutil.dumps(chunk_objs).encode("utf-8")
+    )

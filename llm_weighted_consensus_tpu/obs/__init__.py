@@ -12,7 +12,11 @@ ambient helper here is a single contextvar read returning None.
 """
 
 from .histogram import Histogram  # noqa: F401
-from .ledger import LEDGER_SCHEMA, OutcomeLedger  # noqa: F401
+from .ledger import (  # noqa: F401
+    LEDGER_SCHEMA,
+    OutcomeLedger,
+    load_ledger_records,
+)
 from .phases import (  # noqa: F401
     PHASES,
     observe_device,

@@ -95,3 +95,42 @@ class OutcomeLedger:
             "disk_errors": self._disk_errors,
             "disk_path": self._disk_path,
         }
+
+
+def load_ledger_records(disk_dir: str) -> tuple:
+    """Read back every ledger JSONL file under ``disk_dir`` —
+    ``(records, torn)``.  The read side of the crash-tolerance
+    contract: a replica killed mid-append leaves a torn final line,
+    which is skipped and counted, never fatal — the training pipeline
+    (weights/training_table.py) would rather lose one record than the
+    archive.  Unreadable files are skipped the same way."""
+    records = []
+    torn = 0
+    if not os.path.isdir(disk_dir):
+        return records, torn
+    paths = sorted(
+        os.path.join(disk_dir, f)
+        for f in os.listdir(disk_dir)
+        if f.startswith("ledger-") and f.endswith(".jsonl")
+    )
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+        except OSError:
+            torn += 1
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = jsonutil.loads(line)
+            except ValueError:
+                torn += 1
+                continue
+            if isinstance(record, dict):
+                records.append(record)
+            else:
+                torn += 1
+    return records, torn

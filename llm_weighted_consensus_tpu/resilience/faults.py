@@ -46,6 +46,22 @@ KINDS = (CONNECT, BAD_STATUS, STALL_FIRST, STALL_MID, MALFORMED, TRUNCATE)
 _MALFORMED_FRAME = b"data: {this is not json\n\n"
 
 
+def iter_plan_spec(spec: str, label: str):
+    """Yield ``(key, value)`` pairs from a comma-separated ``key=value``
+    plan spec — the shared grammar of every ``*_PLAN`` env knob
+    (``FAULT_PLAN``, ``JUDGE_BIAS_PLAN``, ``FLEET_FAULT_PLAN``).
+    ``label`` names the knob in error messages so a bad spec points at
+    the env var the operator actually set."""
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"{label}: expected key=value, got {part!r}")
+        key, _, value = part.partition("=")
+        yield key.strip(), value.strip()
+
+
 class FaultPlan:
     """Per-request fault schedule: seeded sampling or an explicit script."""
 
@@ -87,15 +103,7 @@ class FaultPlan:
         stall_ms = 100.0
         probs: Dict[str, float] = {}
         script: Optional[List[Optional[str]]] = None
-        for part in spec.split(","):
-            part = part.strip()
-            if not part:
-                continue
-            if "=" not in part:
-                raise ValueError(f"FAULT_PLAN: expected key=value, got {part!r}")
-            key, _, value = part.partition("=")
-            key = key.strip()
-            value = value.strip()
+        for key, value in iter_plan_spec(spec, "FAULT_PLAN"):
             if key == "seed":
                 seed = int(value)
             elif key == "stall_ms":
@@ -211,17 +219,7 @@ class JudgeBiasPlan:
         after = 0
         probs: Dict[str, float] = {}
         script: Optional[List[Optional[str]]] = None
-        for part in spec.split(","):
-            part = part.strip()
-            if not part:
-                continue
-            if "=" not in part:
-                raise ValueError(
-                    f"JUDGE_BIAS_PLAN: expected key=value, got {part!r}"
-                )
-            key, _, value = part.partition("=")
-            key = key.strip()
-            value = value.strip()
+        for key, value in iter_plan_spec(spec, "JUDGE_BIAS_PLAN"):
             if key == "judge":
                 judge = int(value)
             elif key == "seed":

@@ -776,6 +776,14 @@ class Config:
     fleet_vnodes: int = 64
     fleet_lease_millis: float = 10000.0
     fleet_fetch_timeout_millis: float = 2000.0
+    # deterministic peer fault injection spec (fleet/faults.py
+    # FleetFaultPlan.parse); None = the seam is a single is-None check
+    fleet_fault_plan: Optional[str] = None
+    # consecutive peer transport failures before quarantine (0 = never
+    # quarantine), and how often a quarantined peer is probed for
+    # re-admission
+    fleet_quarantine_failures: int = 3
+    fleet_probe_millis: float = 1000.0
     # fleet-shared serialized-executable store (models/aot_store.py);
     # None = compile every AOT bucket locally as before
     aot_cache_dir: Optional[str] = None
@@ -964,6 +972,11 @@ class Config:
             fleet_fetch_timeout_millis=get_f(
                 "FLEET_FETCH_TIMEOUT_MILLIS", 2000
             ),
+            fleet_fault_plan=env.get("FLEET_FAULT_PLAN"),
+            fleet_quarantine_failures=_non_negative_int(
+                env, "FLEET_QUARANTINE_FAILURES", 3
+            ),
+            fleet_probe_millis=get_f("FLEET_PROBE_MILLIS", 1000),
             aot_cache_dir=env.get("AOT_CACHE_DIR"),
         )
         if config.quality_window < 1:
@@ -1138,6 +1151,17 @@ class Config:
                     f"FLEET_FETCH_TIMEOUT_MILLIS="
                     f"{config.fleet_fetch_timeout_millis} must be > 0"
                 )
+            if config.fleet_probe_millis <= 0:
+                raise ValueError(
+                    f"FLEET_PROBE_MILLIS={config.fleet_probe_millis} must "
+                    "be > 0 (how often a quarantined peer is probed for "
+                    "re-admission, and the owner-side lease-wait slice)"
+                )
+        if config.fleet_fault_plan is not None:
+            # parse eagerly so a typo fails at startup, not mid-drill
+            from ..fleet.faults import FleetFaultPlan
+
+            FleetFaultPlan.parse(config.fleet_fault_plan)
         return config
 
     def backoff_policy(self):
@@ -1284,4 +1308,7 @@ class Config:
             vnodes=self.fleet_vnodes,
             lease_millis=self.fleet_lease_millis,
             fetch_timeout_millis=self.fleet_fetch_timeout_millis,
+            fault_plan_spec=self.fleet_fault_plan,
+            quarantine_failures=self.fleet_quarantine_failures,
+            probe_millis=self.fleet_probe_millis,
         )

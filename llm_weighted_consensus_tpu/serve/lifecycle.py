@@ -102,10 +102,17 @@ class Lifecycle:
         #    stays a cache hit across the departure.  Bounded work
         #    (HANDOFF_MAX_ENTRIES, per-peer timeouts); any failure is
         #    skipped — the fleet re-computes what it must
+        #    The handoff may spend at most HALF the drain budget: under
+        #    a partition every push times out serially-ish even with the
+        #    concurrent gather, and the in-flight streams' share of the
+        #    budget must survive a fully dark fleet
         if self.fleet is not None:
             try:
-                self.handoff_entries = await self.fleet.handoff(
-                    self.caches[0] if self.caches else None
+                self.handoff_entries = await asyncio.wait_for(
+                    self.fleet.handoff(
+                        self.caches[0] if self.caches else None
+                    ),
+                    timeout=max(0.05, (deadline - self.clock()) * 0.5),
                 )
             except Exception:
                 self.handoff_entries = 0

@@ -72,6 +72,7 @@ KNOWN_PROM_FAMILIES = (
     "lwc_judge_drift",
     "lwc_fleet_peer_fetches",
     "lwc_fleet_leases",
+    "lwc_fleet_disruptions",
 )
 
 
@@ -348,6 +349,26 @@ def render_prometheus(metrics: Metrics) -> str:
             "Cross-replica single-flight leases active on this owner.",
         )
         lines.append(f"lwc_fleet_leases {leases.get('active', 0)}")
+        health = fleet.get("health", {})
+        lines += prom_family(
+            "lwc_fleet_disruptions",
+            "counter",
+            "Fleet failure-plane events by kind (partition tolerance).",
+        )
+        for kind, value in (
+            ("ring_divergence", fleet.get("ring_divergences", 0)),
+            ("ring_reject", fleet.get("ring_rejects", 0)),
+            ("early_takeover", fleet.get("early_takeovers", 0)),
+            (
+                "late_publish",
+                leases.get("late_publishes", 0),
+            ),
+            ("quarantine", health.get("quarantines", 0)),
+            ("readmission", health.get("readmissions", 0)),
+        ):
+            lines.append(
+                f'lwc_fleet_disruptions_total{{kind="{kind}"}} {value}'
+            )
 
     lines.append("# EOF")
     return "\n".join(lines) + "\n"

@@ -33,6 +33,20 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 rc_mesh=$?
 [ $rc -eq 0 ] && rc=$rc_mesh
 
+# Fleet partition drill (tests/test_fleet_partition.py): the seeded
+# split-brain drill — three replicas, a scripted {a} | {b,c} cut via
+# FleetFaultPlan, breaker-open + quarantine conditioning, one upstream
+# fan-out per partition component, corrupt-payload rejection, probe
+# re-admission after heal, kill -9 torn-tail recovery, and the whole
+# incident replayed byte-identically from the seed.  Runs in tier-1
+# too; named here so the chaos gate exercises it even when "$@" narrows
+# the marker-based passes above.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_fleet_partition.py -q \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+rc_partition=$?
+[ $rc -eq 0 ] && rc=$rc_partition
+
 # Fleet drill (scripts/fleet_drill.sh): three real replicas sharing a
 # FLEET_PEERS roster + one AOT_CACHE_DIR — a hot fingerprint hits
 # upstream exactly once fleet-wide, a cold replica joins with

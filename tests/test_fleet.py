@@ -32,10 +32,13 @@ from llm_weighted_consensus_tpu.clients.chat import (
 )
 from llm_weighted_consensus_tpu.clients.score import ScoreClient
 from llm_weighted_consensus_tpu.fleet import (
+    FleetClient,
     FleetConfig,
     FleetCoordinator,
+    FleetFaultPlan,
     FleetMembership,
     LeaseTable,
+    PeerHealth,
     clean_chunk_objs,
 )
 from llm_weighted_consensus_tpu.serve import build_app
@@ -458,14 +461,21 @@ def test_unreachable_owner_degrades_to_local_and_breaks():
             if fleet.membership.owner(f"fp-{i}") == dead
         )
         try:
-            for _ in range(4):
+            for _ in range(3):
                 assert await fleet.begin(fp) == ("local", None)
             assert fleet.peer_errors >= 1
-            assert fleet.local_fallbacks >= 4
+            assert fleet.local_fallbacks >= 3
             # connect failures trip the per-peer breaker: later begins
             # stop paying the connect attempt entirely
             snap = fleet.client.breakers.snapshot()
             assert any(b.get("state") == "open" for b in snap.values()), snap
+            # ...and the third consecutive failure QUARANTINES the dead
+            # peer: its keys re-home, so the next begin owns fp locally
+            # (a lease, not a fallback) instead of paying for the corpse
+            assert fleet.health.quarantined() == [dead]
+            status, _ = await fleet.begin(fp)
+            assert status == "lease"
+            assert fleet.membership.owner(fp) == me
         finally:
             await fleet.close()
 
@@ -475,13 +485,14 @@ def test_unreachable_owner_degrades_to_local_and_breaks():
 # -- multi-replica integration (real servers, real peer protocol) -------------
 
 
-def make_node(scripts, self_url, peers, lease_ms, fetch_ms):
+def make_node(scripts, self_url, peers, lease_ms, fetch_ms, **cfg_kw):
     cache = ScoreCache(60, 1 << 20)
     cfg = fleet_cfg(
         self_url,
         peers,
         lease_millis=lease_ms,
         fetch_timeout_millis=fetch_ms,
+        **cfg_kw,
     )
     fleet = FleetCoordinator(cfg)
     fleet.cache = cache
@@ -504,7 +515,7 @@ def make_node(scripts, self_url, peers, lease_ms, fetch_ms):
 
 
 async def start_cluster(
-    scripts_by_node, lease_ms=10000.0, fetch_ms=2000.0
+    scripts_by_node, lease_ms=10000.0, fetch_ms=2000.0, **cfg_kw
 ):
     from aiohttp.test_utils import TestClient, TestServer, unused_port
 
@@ -512,7 +523,9 @@ async def start_cluster(
     urls = [f"http://127.0.0.1:{p}" for p in ports]
     nodes = []
     for i, scripts in enumerate(scripts_by_node):
-        node = make_node(scripts, urls[i], urls, lease_ms, fetch_ms)
+        node = make_node(
+            scripts, urls[i], urls, lease_ms, fetch_ms, **cfg_kw
+        )
         node.client = TestClient(TestServer(node.app, port=ports[i]))
         await node.client.start_server()
         nodes.append(node)
@@ -833,6 +846,361 @@ def test_fleet_metrics_sections_and_prom_families():
             ).text()
             assert 'lwc_fleet_peer_fetches_total{result="hits"} 1' in prom
             assert "lwc_fleet_leases " in prom
+        finally:
+            await stop_cluster(nodes)
+
+    go(run())
+
+
+# -- failure plane: fault plan, ring epochs, takeover, quarantine -------------
+
+
+def test_fault_plan_seeded_determinism_is_pair_local():
+    # the contract the split-brain drill's replay leans on: each ordered
+    # pair's fault sequence depends only on (seed, pair, pair-ordinal),
+    # never on how the event loop interleaves other pairs
+    probs = {"blackhole": 0.25, "slow": 0.25, "5xx": 0.2}
+    a, b, c = "http://a:1", "http://b:1", "http://c:1"
+    grouped = FleetFaultPlan(seed=7, probabilities=probs)
+    seq_ab = [grouped.next_fault(a, b) for _ in range(8)]
+    seq_ac = [grouped.next_fault(a, c) for _ in range(8)]
+    interleaved = FleetFaultPlan(seed=7, probabilities=probs)
+    mixed = [
+        interleaved.next_fault(*pair) for _ in range(8) for pair in ((a, b), (a, c))
+    ]
+    assert seq_ab == mixed[0::2]
+    assert seq_ac == mixed[1::2]
+    # and a different seed draws a different sequence
+    reseeded = FleetFaultPlan(seed=8, probabilities=probs)
+    assert [reseeded.next_fault(a, b) for _ in range(8)] != seq_ab
+
+
+def test_fault_plan_parse_script_scope_and_errors():
+    plan = FleetFaultPlan.parse("seed=3,blackhole=0.5,slow_ms=40,to=http://b:1")
+    assert plan.seed == 3 and plan.slow_ms == 40.0
+    assert plan.probabilities["blackhole"] == 0.5
+    # to= scopes sampled faults to legs TOWARD the listed peers
+    assert all(
+        plan.next_fault("http://a:1", "http://c:1") is None for _ in range(20)
+    )
+
+    scripted = FleetFaultPlan.parse("script=connect|ok|5xx")
+    assert scripted.next_fault("http://a:1", "http://b:1") == "connect"
+    assert scripted.next_fault("http://a:1", "http://b:1") is None
+    assert scripted.next_fault("http://a:1", "http://b:1") == "5xx"
+    assert scripted.next_fault("http://a:1", "http://b:1") is None  # past end
+    # the script replays PER PAIR: a fresh pair starts from slot 0
+    assert scripted.next_fault("http://a:1", "http://c:1") == "connect"
+
+    for bad in ("nope", "bogus=1", "script=warp", "seed"):
+        with pytest.raises(ValueError):
+            FleetFaultPlan.parse(bad)
+
+
+def test_fault_plan_partition_and_heal():
+    a, b, c = "http://a:1", "http://b:1", "http://c:1"
+    plan = FleetFaultPlan()
+    plan.partition([[a], [b, c]])
+    assert plan.next_fault(a, b) == "blackhole"
+    assert plan.next_fault(b, a) == "blackhole"
+    assert plan.next_fault(c, a) == "blackhole"
+    assert plan.next_fault(b, c) is None  # same component: healthy
+    plan.heal()
+    assert plan.next_fault(a, b) is None
+    assert plan.injected["blackhole"] == 3
+    assert plan.snapshot()["rules"] == 0
+
+
+def test_peer_5xx_opens_breaker():
+    # regression: _request used to record breaker SUCCESS for any
+    # answered request, so a peer stuck returning 500s never tripped it
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def run():
+        app = web.Application()
+
+        async def boom(request):
+            return web.json_response(
+                {"error": {"kind": "internal"}}, status=500
+            )
+
+        app.router.add_get("/fleet/v1/entry/{fp}", boom)
+        server_client = TestClient(TestServer(app))
+        await server_client.start_server()
+        peer = str(server_client.make_url("")).rstrip("/")
+        fc = FleetClient("http://me:1", fetch_timeout_ms=500.0)
+        try:
+            for _ in range(3):
+                assert await fc.fetch_entry(peer, "fp") == ("error", None)
+            assert fc.peer_5xx == 3
+            states = {
+                s["state"] for s in fc.breakers.snapshot().values()
+            }
+            assert "open" in states, states
+            # open breaker: the next leg sheds without touching the wire
+            assert await fc.fetch_entry(peer, "fp") == ("error", None)
+            assert fc.peer_5xx == 3
+        finally:
+            await fc.close()
+            await server_client.close()
+
+    go(run())
+
+
+def test_publish_without_running_loop_closes_coro_quietly():
+    # _spawn must not call the deprecated get_event_loop() fallback: with
+    # no running loop the coroutine is closed, not leaked or crashed
+    fleet = make_coordinator(URLS[0], URLS)
+    fp = next(
+        f"fp-{i}"
+        for i in range(1000)
+        if fleet.membership.owner(f"fp-{i}") != URLS[0]
+    )
+    fleet.publish(fp, [])
+    fleet.abandon(fp)
+    assert fleet._tasks == set()
+
+
+def test_publish_routes_on_pinned_view_across_roster_reload(tmp_path):
+    async def run():
+        me = "http://10.0.0.1:5000"
+        other = "http://10.0.0.2:5000"
+        peers_file = tmp_path / "peers.txt"
+        peers_file.write_text(f"{me}\n")
+        now = [0.0]
+        cfg = FleetConfig(self_url=me, peers_file=str(peers_file))
+        fleet = FleetCoordinator(cfg, clock=lambda: now[0])
+        fleet.cache = ScoreCache(60, 1 << 20)
+        # a fingerprint that moves to `other` once the roster grows
+        probe = FleetMembership(fleet_cfg(me, [me, other]))
+        fp = next(
+            f"fp-{i}" for i in range(1000) if probe.owner(f"fp-{i}") == other
+        )
+        epoch0 = fleet.membership.epoch
+        assert await fleet.begin(fp) == ("lease", None)
+        assert fleet.leases.active() == 1
+        # the roster grows MID-REQUEST: the live owner flips away from us
+        peers_file.write_text(f"{me}\n{other}\n")
+        os.utime(peers_file, (1e9, 1e9))
+        now[0] += 2.0
+        assert fleet.membership.owner(fp) == other
+        assert fleet.membership.epoch > epoch0
+        # the publish still routes on the view PINNED at begin: the local
+        # lease retires (waiters wake) and nothing is pushed at the new
+        # owner, who never granted anything
+        fleet.publish(fp, [])
+        assert fleet.leases.active() == 0
+        assert fleet.leases.published == 1
+        assert fleet._tasks == set()
+        await fleet.close()
+
+    go(run())
+
+
+def test_split_roster_divergence_degrades_to_local():
+    async def run():
+        nodes = await start_cluster([[winning_script()], [winning_script()]])
+        try:
+            a, b = nodes
+            body = body_owned_by(nodes, b)  # a must cross the wire
+            # b's roster diverges (a staggered peers-file read: b now
+            # believes it is alone) — its digest no longer matches a's
+            b.fleet.membership._set_peers([b.url])
+            resp = await post_json(a.client, "/score/completions", body)
+            assert resp.status == 200
+            assert a.fleet.ring_divergences == 1
+            assert b.fleet.ring_rejects >= 1
+            # a served the request itself rather than trusting the
+            # divergent owner's lease table
+            assert len(a.transport.requests) == 1
+            assert a.fleet.local_fallbacks >= 1
+        finally:
+            await stop_cluster(nodes)
+
+    go(run())
+
+
+def test_dead_holder_early_takeover_and_late_publish_reconciled():
+    record = recorded_chunks()
+
+    async def run():
+        from aiohttp.test_utils import unused_port
+
+        nodes = await start_cluster(
+            [[winning_script()]],
+            lease_ms=30000.0,
+            fetch_ms=300.0,
+            probe_millis=60.0,
+        )
+        try:
+            node = nodes[0]
+            dead = f"http://127.0.0.1:{unused_port()}"
+            body = body_owned_by(nodes, node)
+            fp = fp_of(body)
+            granted, _ = node.fleet.leases.acquire(fp, dead)
+            assert granted
+            t0 = time.monotonic()
+            resp = await post_json(node.client, "/score/completions", body)
+            assert resp.status == 200
+            elapsed = time.monotonic() - t0
+            # the waiter probed the dead holder and stole the lease in
+            # ~one probe interval — nowhere near the 30 s TTL
+            assert elapsed < 5.0, elapsed
+            assert node.fleet.early_takeovers == 1
+            assert node.fleet.leases.takeovers == 1
+            assert len(node.transport.requests) == 1
+            # the "dead" holder's publish arrives after the steal: a
+            # LATE publish — cached and counted, but it must not retire
+            # the live claimant's state or be double-counted as fresh
+            resp = await node.client.put(
+                f"/fleet/v1/entry/{fp}",
+                data=jsonutil.dumps({"holder": dead, "chunks": record}),
+                headers={"content-type": "application/json"},
+            )
+            assert resp.status == 200
+            out = await resp.json()
+            assert out["accepted"] is True
+            assert out["retired"] is False
+            assert node.fleet.leases.late_publishes == 1
+            assert node.cache.get(fp) is not None
+        finally:
+            await stop_cluster(nodes)
+
+    go(run())
+
+
+def test_breaker_open_holder_is_taken_over_without_probe():
+    async def run():
+        nodes = await start_cluster(
+            [[winning_script()], []], lease_ms=30000.0, probe_millis=60.0
+        )
+        try:
+            a, b = nodes
+            body = body_owned_by(nodes, a)
+            fp = fp_of(body)
+            # b holds a's lease while a's breaker for b is OPEN from
+            # recent transport failures; b's server is alive and would
+            # answer a ping, so only the breaker verdict explains a
+            # takeover this fast
+            granted, _ = a.fleet.leases.acquire(fp, b.url)
+            assert granted
+            breaker = a.fleet.client.breakers.get(b.url, "fleet")
+            for _ in range(3):
+                breaker.record_failure()
+            t0 = time.monotonic()
+            resp = await post_json(a.client, "/score/completions", body)
+            assert resp.status == 200
+            assert time.monotonic() - t0 < 5.0
+            assert a.fleet.early_takeovers == 1
+            assert len(a.transport.requests) == 1
+        finally:
+            await stop_cluster(nodes)
+
+    go(run())
+
+
+def test_peer_health_flap_quarantine_and_probe_readmission():
+    now = [0.0]
+    health = PeerHealth(3, 100.0, clock=lambda: now[0])
+    peer = "http://p:1"
+    # an up/down/up flapper never reaches 3 CONSECUTIVE failures, but
+    # the transition count in the window trips the flap detector
+    for ok in [True, False, True, False, True, False, True]:
+        health.record(peer, ok)
+    assert health.quarantined() == [peer]
+    # once quarantined, traffic outcomes stop mattering — only probes
+    # gate re-admission, at most one per interval
+    health.record(peer, True)
+    assert health.quarantined() == [peer]
+    assert health.probes_due() == []  # interval not yet elapsed
+    now[0] += 0.2
+    assert health.probes_due() == [peer]
+    assert health.probes_due() == []  # stamped: no double probe
+    health.record_probe(peer, False)
+    assert health.quarantined() == [peer]
+    now[0] += 0.2
+    assert health.probes_due() == [peer]
+    health.record_probe(peer, True)
+    assert health.quarantined() == []
+    assert health.stats()["readmissions"] == 1
+    # disabled (FLEET_QUARANTINE_FAILURES=0): inert, never quarantines
+    off = PeerHealth(0, 100.0, clock=lambda: now[0])
+    for _ in range(10):
+        off.record(peer, False)
+    assert off.quarantined() == []
+
+
+def test_ring_digest_agrees_and_quarantine_stays_local():
+    rings = [FleetMembership(fleet_cfg(u, URLS)) for u in URLS]
+    assert len({m.ring_digest() for m in rings}) == 1
+    m = rings[0]
+    epoch0 = m.epoch
+    m.set_quarantined({URLS[1]})
+    assert m.epoch == epoch0 + 1
+    # quarantine re-homes the sick peer's keys but does NOT change the
+    # digest: it is local knowledge, not roster disagreement — otherwise
+    # noticing a sick peer would make every healthy pair look divergent
+    assert m.ring_digest() == rings[1].ring_digest()
+    assert URLS[1] not in {m.owner(f"fp-{i}") for i in range(256)}
+    m.set_quarantined({URLS[1]})  # no change: no rebuild, same epoch
+    assert m.epoch == epoch0 + 1
+    m.set_quarantined(set())
+    assert m.epoch == epoch0 + 2
+    assert URLS[1] in {m.owner(f"fp-{i}") for i in range(256)}
+
+
+def test_departure_view_matches_bruteforce_ring_removal():
+    import xxhash
+
+    me = URLS[0]
+    m = FleetMembership(fleet_cfg(me, URLS))
+
+    def brute(fp):
+        # the pre-optimization algorithm: nearest clockwise vnode over
+        # every peer but self, O(peers x vnodes) per key
+        key = xxhash.xxh3_64_intdigest(fp.encode())
+        best = None
+        for peer in m.peers:
+            if peer == me:
+                continue
+            for i in range(m.config.vnodes):
+                point = xxhash.xxh3_64_intdigest(f"{peer}#{i}".encode())
+                distance = (point - key) % (1 << 64)
+                if best is None or distance < best[0]:
+                    best = (distance, peer)
+        return best[1]
+
+    for i in range(128):
+        assert m.owner_excluding_self(f"fp-{i}") == brute(f"fp-{i}")
+
+
+def test_handoff_pushes_targets_concurrently_under_partition():
+    record = recorded_chunks()
+
+    async def run():
+        nodes = await start_cluster([[], [], []], fetch_ms=400.0)
+        try:
+            a = nodes[0]
+            departure = a.fleet.membership.departure_view()
+            fps = {}
+            for i in range(1000):
+                fps.setdefault(departure.owner(f"fp-{i}"), f"fp-{i}")
+                if len(fps) == 2:
+                    break
+            for fp in fps.values():
+                a.cache.put_chunks(fp, record)
+            # both targets blackholed: each push burns the full fetch
+            # budget — concurrently, or the drain pays it once per target
+            plan = FleetFaultPlan()
+            plan.partition([[a.url], [nodes[1].url, nodes[2].url]])
+            a.fleet.client.fault_plan = plan
+            t0 = time.monotonic()
+            accepted = await a.fleet.handoff(a.cache)
+            elapsed = time.monotonic() - t0
+            assert accepted == 0
+            assert elapsed < 0.75, elapsed  # ~one budget, not two
+            assert plan.injected["blackhole"] == 2
         finally:
             await stop_cluster(nodes)
 

@@ -1,0 +1,386 @@
+"""Fleet partition tolerance: the seeded split-brain drill (ISSUE 17).
+
+A Jepsen-style in-process drill: three REAL replicas on localhost
+sockets share a static roster while every replica's ``FleetClient``
+carries the same seeded ``FleetFaultPlan``.  A scripted schedule cuts
+the fleet into ``{a} | {b, c}``, conditions the cross-partition pairs
+until breakers open and quarantine re-homes the severed keys, drives a
+hot fingerprint into both components, injects a corrupted peer payload,
+then heals and waits for probe re-admission.  The assertions are the
+partition-tolerance contract:
+
+* no response ever carries a degraded or corrupt frame — a replica
+  that cannot reach the fleet computes CLEAN locally;
+* a hot fingerprint costs at most one upstream fan-out per partition
+  component (cross-replica single-flight holds inside each side);
+* after heal, probes re-admit the quarantined peers, the rings
+  converge, and fleet-wide exactly-once is restored;
+* the whole drill replays byte-identically from the seed — every frame
+  of every response (modulo the per-request envelope: random response
+  id, wall-clock created stamp) and every counter — because every
+  fault decision is a pure function of ``(seed, ordered pair, pair
+  ordinal)``.
+
+The kill -9 test is the crash-consistency satellite: a child process is
+SIGKILLed mid-append with a torn JSONL line flushed to both the cache
+disk segment and the outcome ledger; the survivors must load everything
+before the tear and count (never fail on) the tear itself.  The AOT
+store's fail-open variant of the same contract is covered in
+test_fleet.py::test_aot_store_digest_namespaces_and_fail_open.
+"""
+
+import asyncio
+import os
+import re
+import signal
+import subprocess
+import sys
+import textwrap
+
+import xxhash
+
+from llm_weighted_consensus_tpu.cache import ScoreCache
+from llm_weighted_consensus_tpu.fleet import FleetFaultPlan
+from llm_weighted_consensus_tpu.obs import load_ledger_records
+from llm_weighted_consensus_tpu.utils import jsonutil
+
+from test_fleet import (
+    fp_of,
+    go,
+    owner_of,
+    post_json,
+    score_body,
+    start_cluster,
+    stop_cluster,
+    winning_script,
+)
+
+DRILL_SEED = 1729
+
+
+# the per-request ENVELOPE: a random response id and a wall-clock
+# created stamp.  Request identity, not consensus content — the replay
+# contract covers every other byte of every frame.
+_VOLATILE = re.compile(rb'"id":"scrcpl-[0-9a-f]+-\d+"|"created":\d+')
+
+
+def _normalize(payload: bytes) -> bytes:
+    return _VOLATILE.sub(b"", payload)
+
+
+def _clean(payload: bytes) -> bool:
+    """No degraded frame, no fault-injected corruption marker."""
+    return (
+        b'"degraded":true' not in payload and b"corrupt" not in payload
+    )
+
+
+def _upstream(nodes) -> int:
+    return sum(len(n.transport.requests) for n in nodes)
+
+
+async def _settle(nodes):
+    """Await fire-and-forget work (publishes, liveness probes)."""
+    await asyncio.sleep(0.05)
+    for node in nodes:
+        if node.fleet._tasks:
+            await asyncio.gather(
+                *node.fleet._tasks, return_exceptions=True
+            )
+
+
+def _drill_body(tag: str) -> dict:
+    return score_body(
+        messages=[{"role": "user", "content": tag}], stream=True
+    )
+
+
+def _bodies_owned_by(nodes, node, count, tag):
+    """``count`` DISTINCT fingerprints owned by ``node`` on the current
+    (healthy, full) ring — precomputed before the partition so the
+    conditioning schedule is a pure function of the roster."""
+    out, i = [], 0
+    while len(out) < count:
+        body = _drill_body(f"{tag}-{i}")
+        if owner_of(nodes, body) is node:
+            out.append(body)
+        i += 1
+    return out
+
+
+def run_drill(seed: int):
+    """One full partition drill; returns (history digest, counters)."""
+    history = []  # (phase, payload) in a deterministic order
+
+    async def post_ok(node, body, phase):
+        resp = await post_json(node.client, "/score/completions", body)
+        assert resp.status == 200
+        payload = await resp.read()
+        assert _clean(payload), (phase, payload[:200])
+        return payload
+
+    async def record(node, body, phase):
+        history.append((phase, await post_ok(node, body, phase)))
+
+    async def record_gather(posts, phase):
+        # gather preserves ARGUMENT order, so the history is appended
+        # in schedule order, never completion order (which the event
+        # loop does not promise to replay)
+        payloads = await asyncio.gather(
+            *(post_ok(node, body, phase) for node, body in posts)
+        )
+        history.extend((phase, p) for p in payloads)
+        return payloads
+
+    async def drill():
+        nodes = await start_cluster(
+            [[winning_script() for _ in range(16)] for _ in range(3)],
+            lease_ms=30000.0,
+            fetch_ms=250.0,
+            probe_millis=100.0,
+        )
+        a, b, c = nodes
+        try:
+            plans = []
+            for node in nodes:
+                plan = FleetFaultPlan(seed=seed)
+                node.fleet.client.fault_plan = plan
+                plans.append(plan)
+
+            # conditioning schedule, fixed before anything is cut: three
+            # distinct fingerprints per severed pair (three transport
+            # failures open the pair's breaker AND trip quarantine)
+            cond = {
+                "b>a": _bodies_owned_by(nodes, a, 3, "cond-ba"),
+                "c>a": _bodies_owned_by(nodes, a, 3, "cond-ca"),
+                "a>b": _bodies_owned_by(nodes, b, 3, "cond-ab"),
+                "a>c": _bodies_owned_by(nodes, c, 3, "cond-ac"),
+            }
+
+            # -- phase 1: healthy — exactly-once fleet-wide ---------------
+            bodies = [_drill_body(f"drill-{i}") for i in range(6)]
+            for i, body in enumerate(bodies):
+                await record(nodes[i % 3], body, "healthy")
+            await _settle(nodes)
+            assert _upstream(nodes) == len(bodies)
+            # replay on a different replica: peer fetch, zero upstream
+            for i, body in enumerate(bodies):
+                await record(nodes[(i + 1) % 3], body, "warm")
+            assert _upstream(nodes) == len(bodies)
+
+            # -- phase 2: partition {a} | {b, c} --------------------------
+            for plan in plans:
+                plan.partition([[a.url], [b.url, c.url]])
+            # start conditioning from a clean breaker slate: phase-1
+            # successes would otherwise open a pair's breaker after two
+            # failures (rate 0.5) and shed the third leg before the
+            # quarantine bar, making the trip depend on which ports the
+            # fingerprints hashed to.  The breaker-open degradation path
+            # itself is covered by test_fleet.py::
+            # test_unreachable_owner_degrades_to_local_and_breaks.
+            for node in nodes:
+                for breaker in node.fleet.client.breakers._breakers.values():
+                    breaker.force_close()
+            for r in range(3):
+                await record_gather(
+                    [
+                        (b, cond["b>a"][r]),
+                        (c, cond["c>a"][r]),
+                        (a, cond["a>b"][r]),
+                        (a, cond["a>c"][r]),
+                    ],
+                    "conditioning",
+                )
+            assert b.fleet.health.quarantined() == [a.url]
+            assert c.fleet.health.quarantined() == [a.url]
+            assert a.fleet.health.quarantined() == sorted(
+                [b.url, c.url]
+            )
+
+            # hot fingerprint into BOTH components at once: at most one
+            # upstream fan-out per component, every frame clean
+            before = _upstream(nodes)
+            hot = _drill_body("hot-question")
+            hot_payloads = await record_gather(
+                [(n, hot) for n in nodes], "hot"
+            )
+            await _settle(nodes)
+            assert _upstream(nodes) - before == 2  # == components
+            # inside {b, c} the lease collapsed the pair to one result
+            assert hot_payloads[1] == hot_payloads[2]
+
+            # -- phase 3: heal, then a mangled peer payload ---------------
+            for plan in plans:
+                plan.heal()
+            victim = _drill_body("mangle-probe")
+            owner_url = b.fleet.membership.view().owner(fp_of(victim))
+            owner = next(n for n in nodes if n.url == owner_url)
+            await record(owner, victim, "mangle-populate")
+            await _settle(nodes)
+            reader = b if owner is not b else c
+            reader.fleet.client.fault_plan.set_pair(
+                reader.url, owner.url, "corrupt", count=1
+            )
+            before = _upstream(nodes)
+            errors_before = reader.fleet.peer_errors
+            await record(reader, victim, "mangle")
+            # the wire guard refused the mangled record: the reader
+            # recomputed locally (one upstream) and served clean bytes
+            assert _upstream(nodes) - before == 1
+            assert reader.fleet.peer_errors == errors_before + 1
+
+            # -- phase 4: probe re-admission + convergence ----------------
+            await asyncio.sleep(0.15)  # at least one probe interval
+            # one kick per node: each begin folds the health verdict in
+            # and spawns the due liveness probes
+            await record_gather(
+                [
+                    (n, _drill_body(f"heal-kick-{i}"))
+                    for i, n in enumerate(nodes)
+                ],
+                "heal-kick",
+            )
+            await _settle(nodes)  # awaits the probe tasks themselves
+            for node in nodes:
+                assert node.fleet.health.quarantined() == []
+                assert node.fleet.membership.quarantined() == []
+            assert len(
+                {n.fleet.membership.ring_digest() for n in nodes}
+            ) == 1
+            # exactly-once restored fleet-wide
+            before = _upstream(nodes)
+            healed = _drill_body("post-heal-hot")
+            await record_gather([(n, healed) for n in nodes], "healed")
+            await _settle(nodes)
+            assert _upstream(nodes) - before == 1
+
+            return {
+                "upstream_total": _upstream(nodes),
+                "quarantines": [
+                    n.fleet.health.quarantines for n in nodes
+                ],
+                "readmissions": [
+                    n.fleet.health.readmissions for n in nodes
+                ],
+                "ring_divergences": [
+                    n.fleet.ring_divergences for n in nodes
+                ],
+                "ring_rejects": [n.fleet.ring_rejects for n in nodes],
+                "early_takeovers": [
+                    n.fleet.early_takeovers for n in nodes
+                ],
+                "peer_5xx": [n.fleet.client.peer_5xx for n in nodes],
+            }
+        finally:
+            await stop_cluster(nodes)
+
+    counters = go(drill())
+    digest = xxhash.xxh3_64_hexdigest(
+        b"|".join(
+            phase.encode() + b":" + _normalize(payload)
+            for phase, payload in history
+        )
+    )
+    return digest, counters, [phase for phase, _ in history]
+
+
+def test_partition_drill_split_brain_and_heal():
+    digest, counters, phases = run_drill(DRILL_SEED)
+    # the minority node quarantined both majority nodes; each majority
+    # node quarantined the minority — and every quarantine was undone
+    # by a probe re-admission after the heal
+    assert counters["quarantines"] == [2, 1, 1]
+    assert counters["readmissions"] == [2, 1, 1]
+    # a static roster never diverges: the cut was at the transport, not
+    # the ring — no 409s, no divergence fallbacks
+    assert counters["ring_divergences"] == [0, 0, 0]
+    assert counters["ring_rejects"] == [0, 0, 0]
+    assert counters["early_takeovers"] == [0, 0, 0]
+    assert counters["peer_5xx"] == [0, 0, 0]
+    assert len(digest) == 16
+    for phase in (
+        "healthy",
+        "warm",
+        "conditioning",
+        "hot",
+        "mangle",
+        "heal-kick",
+        "healed",
+    ):
+        assert phase in phases
+
+
+def test_partition_drill_replays_byte_identically_from_seed():
+    first = run_drill(DRILL_SEED)
+    second = run_drill(DRILL_SEED)
+    # every response byte in every phase, and every counter — the
+    # incident is a pure function of the seed
+    assert first[0] == second[0]
+    assert first[1] == second[1]
+    assert first[2] == second[2]
+
+
+# -- crash consistency: kill -9 mid-append ------------------------------------
+
+
+_CHILD = textwrap.dedent(
+    """
+    import os, signal
+    from llm_weighted_consensus_tpu.cache import ScoreCache
+    from llm_weighted_consensus_tpu.obs import OutcomeLedger
+
+    cache = ScoreCache(600.0, 1 << 20, disk_dir={cache_dir!r})
+    for i in range(3):
+        cache.put_chunks(
+            "fp-%d" % i,
+            [{{"id": "chunk-%d" % i, "object": "chat.completion.chunk"}}],
+        )
+    # torn tail: a partial record flushed right before the crash
+    cache._segment.write('{{"k":"fp-torn","e":9e9,"v":[')
+    cache._segment.flush()
+    os.fsync(cache._segment.fileno())
+
+    ledger = OutcomeLedger(capacity=8, disk_dir={ledger_dir!r})
+    ledger.offer({{"id": "r-0", "verdict": "ok"}})
+    ledger.offer({{"id": "r-1", "verdict": "ok"}})
+    with open(ledger._disk_path, "a", encoding="utf-8") as f:
+        f.write('{{"id": "r-torn", "ver')
+        f.flush()
+        os.fsync(f.fileno())
+
+    os.kill(os.getpid(), signal.SIGKILL)
+    """
+)
+
+
+def test_kill9_mid_append_recovers_and_counts_the_tear(tmp_path):
+    cache_dir = str(tmp_path / "cache")
+    ledger_dir = str(tmp_path / "ledger")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            _CHILD.format(cache_dir=cache_dir, ledger_dir=ledger_dir),
+        ],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    # restart: everything before the tear loads; the tear is counted,
+    # never fatal
+    reborn = ScoreCache(600.0, 1 << 20, disk_dir=cache_dir)
+    assert reborn.disk_loaded == 3
+    assert reborn.disk_torn == 1
+    assert reborn.stats()["disk_torn"] == 1
+    for i in range(3):
+        assert reborn.get(f"fp-{i}") == [
+            {"id": f"chunk-{i}", "object": "chat.completion.chunk"}
+        ]
+    records, torn = load_ledger_records(ledger_dir)
+    assert [r["id"] for r in records] == ["r-0", "r-1"]
+    assert torn == 1
+    # round-trip: the surviving records re-serialize intact
+    assert jsonutil.loads(jsonutil.dumps(records[0]))["verdict"] == "ok"

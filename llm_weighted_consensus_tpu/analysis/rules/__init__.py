@@ -45,6 +45,7 @@ from . import (  # noqa: E402
     lwc014_guarded_field,
     lwc015_lock_order,
     lwc016_blocking_under_lock,
+    lwc017_frame_rebuild_in_merge_loop,
 )
 
 ALL_RULES: Tuple[Rule, ...] = (
@@ -64,6 +65,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     lwc014_guarded_field.RULE,
     lwc015_lock_order.RULE,
     lwc016_blocking_under_lock.RULE,
+    lwc017_frame_rebuild_in_merge_loop.RULE,
 )
 
 RULES_BY_NAME = {rule.name: rule for rule in ALL_RULES}

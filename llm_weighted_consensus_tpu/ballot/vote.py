@@ -19,9 +19,16 @@ from ..errors import InvalidContentError
 from .tree import ALPHABET, PrefixTree
 
 
-def _last_match(pattern: str, content: str) -> Optional[str]:
+def _last_match(pattern: "str | re.Pattern", content: str) -> Optional[str]:
     last = None
-    for m in re.finditer(pattern, content):
+    # pre-compiled patterns scan through their own method: re.finditer
+    # on a Pattern object pays a failed module-cache probe (KeyError in
+    # re._compile) on every call
+    if isinstance(pattern, re.Pattern):
+        it = pattern.finditer(content)
+    else:
+        it = re.finditer(pattern, content)
+    for m in it:
         last = m
     return last.group(0) if last else None
 
@@ -49,13 +56,15 @@ def _char_at_byte(token: str, byte_index: int) -> Optional[str]:
 
 def find_key(
     content: Optional[str],
-    with_ticks_pattern: str,
-    without_ticks_pattern: str,
+    with_ticks_pattern: "str | re.Pattern",
+    without_ticks_pattern: "str | re.Pattern",
 ) -> Optional[str]:
     """Last ballot-key occurrence in ``content``: models often restate keys
     while reasoning, the final statement is the decision
     (client.rs:1675-1688).  Backticked match preferred, tick-stripped
-    fallback."""
+    fallback.  Patterns may arrive pre-compiled (the HOST_FASTPATH judge
+    stream compiles its two ballot patterns once per panel member);
+    matches are identical either way."""
     if not content:
         return None
     key = _last_match(with_ticks_pattern, content)
@@ -71,8 +80,8 @@ def final_letter(key: str) -> str:
 
 def extract_vote(
     tree: PrefixTree,
-    with_ticks_pattern: str,
-    without_ticks_pattern: str,
+    with_ticks_pattern: "str | re.Pattern",
+    without_ticks_pattern: "str | re.Pattern",
     n_choices: int,
     content: Optional[str],
     logprob_tokens: Optional[list] = None,

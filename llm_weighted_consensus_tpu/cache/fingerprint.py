@@ -85,7 +85,11 @@ def score_fingerprint(params, ctx: Optional[str] = None) -> Optional[str]:
     hasher.write("\x00")
     hasher.write(ctx or "")
     hasher.write("\x00")
-    hasher.write(jsonutil.dumps(obj))
+    # the parsed request streams straight into the hasher in bounded
+    # chunks — the full canonical string (large message payloads, inline
+    # panels) is never materialized; digest bytes are identical to the
+    # dumps() form (pinned in tests/test_host_fastpath.py)
+    jsonutil.dump_into(obj, hasher.write)
     return hasher.finish_id()
 
 

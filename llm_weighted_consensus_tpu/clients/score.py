@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import re
 import time
 from typing import AsyncIterator, Optional
 
@@ -78,6 +79,7 @@ from ..types.score_response import (
 from ..utils import ChoiceIndexer, jsonutil, response_id
 from ..weights import WeightFetchers
 from .chat import ChatClient
+from .tally import fixed_point_fold
 
 RESPONSE_ID_PREFIX = "scrcpl"
 
@@ -309,54 +311,64 @@ def _message_to_delta(message) -> Delta:
 # ---------------------------------------------------------------------------
 
 
+# terminal queue markers for merge_streams: module-private, so a judge
+# stream can never yield one as a payload (identity-checked)
+_PUMP_DONE = object()
+
+
+class _PumpCrash:
+    """A pump task's exception, surfaced through the queue in FIFO order
+    so items the crashed judge already delivered still drain first."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 async def merge_streams(streams: list) -> AsyncIterator:
     """Unordered interleaved merge of async iterators (futures select_all,
     client.rs:342-356).  Items surface in arrival order across all judges."""
     # Bounded queue preserves select_all's pull-based backpressure: a slow
     # downstream consumer throttles upstream judge reads instead of
-    # buffering every provider token in memory.  Completion is tracked via
-    # the pump tasks themselves (not queue sentinels) so an abandoned
-    # consumer can always cancel pumps blocked on a full queue.
+    # buffering every provider token in memory.  Exactly one pump task per
+    # stream for the whole merge — completion and pump crashes travel
+    # through the queue as terminal markers, so the consumer is a plain
+    # ``await queue.get()`` with zero per-chunk task creation (the old
+    # select loop burned a fresh ``queue.get()`` task plus a rebuilt
+    # ``asyncio.wait`` pending-set per item; tests/test_host_fastpath.py
+    # pins the no-churn contract).
     queue: asyncio.Queue = asyncio.Queue(maxsize=16)
 
     async def pump(stream):
-        async for item in stream:
-            await queue.put(item)
+        try:
+            async for item in stream:
+                await queue.put(item)
+        except Exception as exc:
+            # judge streams themselves never raise; this catches
+            # programming errors instead of hanging the merge.
+            # CancelledError is not an Exception and propagates.
+            await queue.put(_PumpCrash(exc))
+            return
+        await queue.put(_PUMP_DONE)
 
     tasks = [asyncio.create_task(pump(s)) for s in streams]
-    getter = None
+    remaining = len(tasks)
     try:
-        while True:
-            # drain already-arrived items from healthy judges FIRST, then
-            # propagate pump crashes (judge streams themselves never raise;
-            # this catches programming errors instead of hanging)
-            while not queue.empty():
-                yield queue.get_nowait()
-            for t in tasks:
-                if t.done() and not t.cancelled() and t.exception() is not None:
-                    raise t.exception()
-            if all(t.done() for t in tasks):
-                if queue.empty():
-                    break
-                continue
-            if getter is None:
-                getter = asyncio.create_task(queue.get())
-            await asyncio.wait(
-                {getter, *(t for t in tasks if not t.done())},
-                return_when=asyncio.FIRST_COMPLETED,
-            )
-            if getter.done():
-                item = getter.result()
-                getter = None
+        while remaining:
+            item = await queue.get()
+            if item is _PUMP_DONE:
+                remaining -= 1
+            elif type(item) is _PumpCrash:
+                raise item.exc
+            else:
                 yield item
     finally:
-        cleanup = list(tasks)
-        if getter is not None:
-            getter.cancel()
-            cleanup.append(getter)
+        # an abandoned consumer can always cancel pumps blocked on a full
+        # queue — the markers above never wedge shutdown
         for t in tasks:
             t.cancel()
-        await asyncio.gather(*cleanup, return_exceptions=True)
+        await asyncio.gather(*tasks, return_exceptions=True)
 
 
 # ---------------------------------------------------------------------------
@@ -379,6 +391,7 @@ class ScoreClient:
         bias_plan=None,
         ledger=None,
         fleet=None,
+        host_fastpath: bool = False,
     ) -> None:
         self.chat_client = chat_client
         self.model_fetcher = model_fetcher
@@ -413,6 +426,11 @@ class ScoreClient:
         # the fleet — peer cache fetch or a cross-replica lease — so a
         # fleet-wide hot fingerprint hits upstream exactly once
         self.fleet = fleet
+        # HOST_FASTPATH: run the tally fold on scaled-int64 numpy vectors
+        # (clients/tally.py) and hoist the per-candidate share divisions;
+        # off = the Decimal loops below, byte-identical either way — any
+        # ballot the fast lane cannot prove exact falls back per request
+        self.host_fastpath = host_fastpath
 
     # -- unary (client.rs:71-91) --------------------------------------------
 
@@ -751,10 +769,20 @@ class ScoreClient:
         t_tally = time.perf_counter()
         tspan = obs.child_span("consensus:tally", n_judges=len(model.llms))
 
-        choice_weight = [Decimal(0)] * n_choices
+        tail = aggregate.choices[n_choices:]
+        choice_weight = None
+        if self.host_fastpath:
+            # HOST_FASTPATH: the weighted-vote fold on scaled-int64 numpy
+            # vectors — byte-identical by construction, None when the
+            # ballots cannot be proven exact (the Decimal loop below is
+            # the authority and re-runs in full)
+            choice_weight = fixed_point_fold(tail, n_choices)
+        fold_in_loop = choice_weight is None
+        if fold_in_loop:
+            choice_weight = [Decimal(0)] * n_choices
         all_error = True
         all_error_code: Optional[int] = None
-        for choice in aggregate.choices[n_choices:]:
+        for choice in tail:
             if all_error:
                 if choice.error is None:
                     all_error = False
@@ -768,7 +796,7 @@ class ScoreClient:
                         all_error_code = 400
                     else:
                         all_error_code = 500
-            if choice.delta.vote is not None:
+            if fold_in_loop and choice.delta.vote is not None:
                 w = choice.weight if choice.weight is not None else Decimal(0)
                 for i, v in enumerate(choice.delta.vote):
                     choice_weight[i] += v * w
@@ -797,13 +825,37 @@ class ScoreClient:
         want_ledger = self.ledger is not None
         conf_vec = [0.0] * n_choices
         ledger_judges: list = []
+        # HOST_FASTPATH: the share choice_weight[i]/weight_sum is divided
+        # out once per candidate instead of once per judge per candidate
+        # (the division is deterministic, so hoisting it is byte-identical
+        # to the slow lane's in-loop recompute below)
+        shares = None
+        if self.host_fastpath:
+            if weight_sum > 0:
+                # identical weight OBJECTS (the fixed-point fold memoizes
+                # repeated sums onto one Decimal) share one division and
+                # one result object — deterministic division makes this
+                # byte-identical, and downstream the splice encoder
+                # formats each shared confidence object once
+                div_memo: dict = {}
+                shares = []
+                for w in choice_weight:
+                    hit = div_memo.get(id(w))
+                    if hit is None:
+                        div_memo[id(w)] = hit = (w, w / weight_sum)
+                    shares.append(hit[1])
+            else:
+                shares = [Decimal(0)] * n_choices
         for choice in aggregate.choices:
             if choice.index < n_choices:
                 w = choice_weight[choice.index]
                 choice.weight = w
-                choice.confidence = (
-                    w / weight_sum if weight_sum > 0 else Decimal(0)
-                )
+                if shares is not None:
+                    choice.confidence = shares[choice.index]
+                else:
+                    choice.confidence = (
+                        w / weight_sum if weight_sum > 0 else Decimal(0)
+                    )
                 if want_ledger:
                     conf_vec[choice.index] = float(choice.confidence)
                 if tspan is not None:
@@ -817,13 +869,17 @@ class ScoreClient:
             elif choice.delta.vote is not None:
                 vote = choice.delta.vote
                 confidence = Decimal(0)
-                for i, v in enumerate(vote):
-                    share = (
-                        choice_weight[i] / weight_sum
-                        if weight_sum > 0
-                        else Decimal(0)
-                    )
-                    confidence += share * v
+                if shares is not None:
+                    for i, v in enumerate(vote):
+                        confidence += shares[i] * v
+                else:
+                    for i, v in enumerate(vote):
+                        share = (
+                            choice_weight[i] / weight_sum
+                            if weight_sum > 0
+                            else Decimal(0)
+                        )
+                        confidence += share * v
                 choice.confidence = confidence
                 judge_weight = (
                     choice.weight if choice.weight is not None else Decimal(0)
@@ -1077,6 +1133,16 @@ class ScoreClient:
         keys = [k for k, _ in key_indices]
         ballot_json = serialize_ballot(request.choices, key_indices)
         with_ticks, without_ticks = PrefixTree.regex_patterns(keys)
+        if self.host_fastpath:
+            # compile the per-judge ballot patterns once: every ballot
+            # alphabet is freshly randomized, so the module-level re
+            # cache (512 entries, cleared wholesale when full) churns
+            # under concurrent panels, and the final frame re-scans both
+            # patterns once per choice.  ``re.finditer`` accepts Pattern
+            # objects, so find_key/extract_vote thread them unchanged —
+            # matches (and therefore bytes) are identical either way.
+            with_ticks = re.compile(with_ticks)
+            without_ticks = re.compile(without_ticks)
         if self.ballot_sink is not None:
             self.ballot_sink(resp_id, llm.index, list(key_indices))
 

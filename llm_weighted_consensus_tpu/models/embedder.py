@@ -1267,16 +1267,23 @@ class TpuEmbedder:
         """Wrap already-computed embeddings as the OpenAI response
         (types/embeddings.py) with usage = real token counts for cost
         accounting — the assembly half of ``embeddings_response``, split
-        out so batched callers (serve/batcher.py) can reuse it."""
+        out so batched callers (serve/batcher.py) can reuse it.
+
+        Row assembly is one bulk ``tolist()`` — a single C-level
+        device-to-host conversion — instead of a Python ``float(v)``
+        call per element; values are identical (``tolist`` applies the
+        same per-element widening ``item()`` conversion).  Before/after
+        numbers live in BENCH_host.json ("embed_assembly")."""
+        rows = np.asarray(emb).tolist()
         return CreateEmbeddingResponse(
             object="list",
             data=[
                 Embedding(
                     object="embedding",
                     index=i,
-                    embedding=[float(v) for v in row],
+                    embedding=row,
                 )
-                for i, row in enumerate(emb)
+                for i, row in enumerate(rows)
             ],
             model=self.model_name,
             usage=Usage(

@@ -1176,6 +1176,8 @@ def build_service(
         # FLEET_*: cross-replica peer fetch + single-flight leases; None
         # preserves single-replica behavior
         fleet=fleet,
+        # HOST_FASTPATH: fixed-point vectorized tally (clients/tally.py)
+        host_fastpath=config.host_fastpath,
     )
     multichat_client = MultichatClient(
         chat_client, model_registry, archive_fetcher=store
@@ -1244,6 +1246,8 @@ def build_service(
         trace_sink=config.trace_sink(),
         ledger=ledger,
         fleet=fleet,
+        # HOST_FASTPATH: splice-serialized SSE frames (serve/frames.py)
+        host_fastpath=config.host_fastpath,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables

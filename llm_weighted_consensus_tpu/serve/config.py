@@ -112,6 +112,14 @@ TPU additions:
   concatenates pre-built rows and group k+1's tokenization never rides
   the dispatch thread behind group k.  ``0`` tokenizes on the dispatch
   thread (the pre-overlap behavior).  Default 2.
+* ``HOST_FASTPATH`` — host fast lane for the streaming consensus path:
+  per-chunk SSE frames are assembled by splicing changed fields into
+  precompiled byte templates (serve/frames.py) instead of a full
+  ``to_json_obj`` + ``dumps`` per chunk, and the per-push weighted
+  tally runs on scaled-int64 numpy vectors (clients/tally.py) with the
+  Decimal fold retained as the final-frame authority.  Both lanes fall
+  back loudly to the slow path whenever exactness cannot be proven, so
+  output bytes are identical either way.  Default ``0`` (off).
 * ``STAGING_BUFFERS`` — reusable host staging buffers kept per
   (shape, dtype) bucket for the padded dispatch paths; the batcher's
   waiter recycles each buffer once its transfer is ready instead of
@@ -664,6 +672,10 @@ class Config:
     batch_max_rows: int = 512
     # submit-time tokenization pool (0 = tokenize on dispatch thread)
     host_tokenizer_workers: int = 2
+    # host fast lane for the streaming consensus path (serve/frames.py
+    # splice templates + clients/tally.py fixed-point tally); off = the
+    # byte-identical slow path everywhere
+    host_fastpath: bool = False
     # reusable host staging buffers per (shape, dtype); 0 = no reuse
     staging_buffers: int = 2
     # continuous batching (serve/packing.py): ragged segment-id packing
@@ -871,6 +883,7 @@ class Config:
             host_tokenizer_workers=_non_negative_int(
                 env, "HOST_TOKENIZER_WORKERS", 2
             ),
+            host_fastpath=env_truthy(env.get("HOST_FASTPATH", "0")),
             staging_buffers=_non_negative_int(env, "STAGING_BUFFERS", 2),
             packing_enabled=env_truthy(env.get("PACKING_ENABLED", "0")),
             packing_row_tokens=max(

@@ -21,6 +21,7 @@ from ..errors import (
     to_response_error,
     with_trace_id,
 )
+from . import frames
 from .metrics import (
     PROM_CONTENT_TYPE,
     Metrics,
@@ -94,22 +95,30 @@ def _error_response(e: Exception) -> web.Response:
 
 
 def _frame(obj) -> bytes:
-    return b"data: " + jsonutil.dumps(obj).encode("utf-8") + b"\n\n"
+    # kept as the module's one-frame helper for non-loop callers; the
+    # per-chunk loop below goes through frames.FrameEncoder (LWC017)
+    return frames.frame_bytes(obj)
 
 
-async def _respond_streaming(request: web.Request, stream) -> web.StreamResponse:
+async def _respond_streaming(
+    request: web.Request, stream, fastpath: bool = False
+) -> web.StreamResponse:
     resp = web.StreamResponse(headers=SSE_HEADERS)
     await resp.prepare(request)
+    encoder = frames.FrameEncoder(fastpath)
     try:
         async for item in stream:
             if isinstance(item, Exception):
                 # a mid-stream error makes this trace worth keeping even
                 # when head sampling said no (sink.py retention rule)
                 obs.force_keep("stream_error")
-                payload = with_trace_id(to_response_error(item).to_json_obj())
+                await resp.write(encoder.encode_error(item))
             else:
-                payload = item.to_json_obj()
-            await resp.write(_frame(payload))
+                await resp.write(encoder.encode(item))
+        if encoder.fallbacks:
+            # fast-lane frames that fell back to the slow path: loud in
+            # the trace, invisible on the wire (bytes are identical)
+            obs.annotate(fastpath_fallbacks=encoder.fallbacks)
         await resp.write(DONE)
     except (ConnectionResetError, ConnectionError):
         # the client disconnected mid-stream: nothing left to say to it,
@@ -309,7 +318,7 @@ def _judge_handlers():
     return index, get_one
 
 
-def _make_handler(params_cls, create_streaming, create_unary):
+def _make_handler(params_cls, create_streaming, create_unary, fastpath=False):
     async def handler(request: web.Request):
         try:
             body = jsonutil.loads(await request.text())
@@ -326,7 +335,7 @@ def _make_handler(params_cls, create_streaming, create_unary):
                 stream = await create_streaming(ctx, params)
             except Exception as e:
                 return _error_response(e)
-            return await _respond_streaming(request, stream)
+            return await _respond_streaming(request, stream, fastpath)
         try:
             result = await create_unary(ctx, params)
         except Exception as e:
@@ -582,6 +591,7 @@ def build_app(
     trace_sink=None,
     ledger=None,
     fleet=None,
+    host_fastpath: bool = False,
 ) -> web.Application:
     metrics = metrics or Metrics()
     register_resilience(metrics, resilience, fault_plan)
@@ -678,6 +688,7 @@ def build_app(
             ChatParams,
             chat_client.create_streaming,
             chat_client.create_unary,
+            fastpath=host_fastpath,
         ),
     )
     app.router.add_post(
@@ -686,6 +697,7 @@ def build_app(
             ScoreParams,
             score_client.create_streaming,
             score_client.create_unary,
+            fastpath=host_fastpath,
         ),
     )
     if multichat_client is not None:
@@ -697,6 +709,7 @@ def build_app(
                     multichat_client, embedder, metrics, batcher
                 ),
                 _multichat_unary(multichat_client, embedder, batcher),
+                fastpath=host_fastpath,
             ),
         )
     if embedder is not None:

@@ -81,6 +81,33 @@ def _format_float(f: float) -> str:
     return float.__repr__(f)
 
 
+def scalar_token(obj) -> "str | None":
+    """The exact token the compact writer emits for a JSON scalar, or
+    ``None`` when ``obj`` is not a scalar (containers, unknown types).
+
+    This is ``_write_scalar``'s dispatch factored out for the splice
+    serializer (types/base.py SpliceEncoder): frame assembly from byte
+    templates must format every leaf exactly as ``dumps`` would, or the
+    fast lane's byte-identity contract breaks.  Order matters — ``bool``
+    before ``int`` (bool is an int subclass), ``Decimal`` before the
+    numeric tower."""
+    if obj is None:
+        return "null"
+    if obj is True:
+        return "true"
+    if obj is False:
+        return "false"
+    if isinstance(obj, str):
+        return _escape_string(obj)
+    if isinstance(obj, Decimal):
+        return _format_decimal(obj)
+    if isinstance(obj, int):
+        return int.__repr__(obj)
+    if isinstance(obj, float):
+        return _format_float(obj)
+    return None
+
+
 def dumps(obj, *, pretty: bool = False) -> str:
     """Serialize ``obj`` (dict/list/str/bool/None/int/float/Decimal) to JSON.
 
@@ -117,6 +144,47 @@ def dumps(obj, *, pretty: bool = False) -> str:
     else:
         _write_compact(obj, out, set())
     return "".join(out)
+
+
+class _EmitBuffer:
+    """Bounded segment buffer quacking like the writer's ``out`` list.
+
+    ``_write_compact`` only ever calls ``out.append``, so handing it this
+    buffer streams the exact compact byte sequence through ``emit`` in
+    joined chunks — no full canonical string is ever materialized.  The
+    cache fingerprint path (cache/fingerprint.py) feeds chunks straight
+    into the incremental request hasher."""
+
+    __slots__ = ("_emit", "_buf", "_size", "_limit")
+
+    def __init__(self, emit, limit: int):
+        self._emit = emit
+        self._buf: list[str] = []
+        self._size = 0
+        self._limit = limit
+
+    def append(self, segment: str) -> None:
+        self._buf.append(segment)
+        self._size += len(segment)
+        if self._size >= self._limit:
+            self._emit("".join(self._buf))
+            self._buf.clear()
+            self._size = 0
+
+    def flush(self) -> None:
+        if self._buf:
+            self._emit("".join(self._buf))
+            self._buf.clear()
+            self._size = 0
+
+
+def dump_into(obj, emit, *, chunk_chars: int = 8192) -> None:
+    """Stream ``dumps(obj)`` (compact form) through ``emit(str)`` in
+    bounded chunks, byte-identical to the full-string writer — same
+    recursive walk, same segments, only the join boundaries differ."""
+    buf = _EmitBuffer(emit, chunk_chars)
+    _write_compact(obj, buf, set())
+    buf.flush()
 
 
 def _write_scalar(obj, out: list[str]) -> bool:

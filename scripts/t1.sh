@@ -30,6 +30,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_quality.py -
 # drill, the overlap gauge, staging-pool recycling) must fail tier-1 by
 # name even if collection of the glob above breaks.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_perfobs.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_po=$?; [ $rc -eq 0 ] && rc=$rc_po; \
+# host fast-path tests, explicitly: splice-frame byte identity across
+# lanes (seeded orders, degraded frames, per-judge errors, the Decimal
+# exponent-drift cache hazard), Decimal<->fixed-point tally parity on
+# pathological weights, merge_streams no-task-churn, and the streamed
+# fingerprint digest parity must fail tier-1 by name even if collection
+# of the glob above breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_host_fastpath.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_hf=$?; [ $rc -eq 0 ] && rc=$rc_hf; \
+# host-path perf budget gate: bench_host.py --hostpath measures the
+# fast lane's per-phase p50s (ingest/merge/tally/encode + per-chunk
+# composite) at J=8 x N=64 and fails when any phase exceeds the
+# committed analysis/host_budgets.json budget x band (a >=25% host-path
+# regression).  Re-baseline with --write-budgets (DESIGN.md "Host fast
+# path").
+timeout -k 10 300 env JAX_PLATFORMS=cpu python bench_host.py --hostpath > /tmp/_t1_hostpath.json; rc_hp=$?; [ $rc -eq 0 ] && rc=$rc_hp; \
 # analysis gate, explicitly: tests/test_analysis.py runs the same checker
 # under pytest, but naming the CLI here means a lint finding, a jaxpr
 # serving-path regression, or a mesh-audit failure (sharding coverage /

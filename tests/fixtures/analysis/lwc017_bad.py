@@ -1,0 +1,11 @@
+"""LWC017 violating fixture: the streaming merge loop rebuilds every
+SSE frame from scratch — full dict materialization + full dumps per
+merged chunk."""
+
+from llm_weighted_consensus_tpu.utils import jsonutil
+
+
+async def respond_streaming(response, merged):
+    async for chunk in merged:
+        obj = chunk.to_json_obj()
+        await response.write(b"data: " + jsonutil.dumps(obj).encode() + b"\n\n")

@@ -1,0 +1,17 @@
+"""LWC017 conforming fixture: per-chunk bytes come from the fast-lane
+frame encoder (splice serialization, serve/frames.py); full
+serialization happens only outside the merge loop."""
+
+from llm_weighted_consensus_tpu.serve import frames
+from llm_weighted_consensus_tpu.utils import jsonutil
+
+
+async def respond_streaming(response, merged, fastpath):
+    encoder = frames.FrameEncoder(fastpath)
+    async for chunk in merged:
+        await response.write(encoder.encode(chunk))
+
+
+def error_body(err_obj) -> bytes:
+    # one-shot (non-streaming) serialization is fine anywhere
+    return jsonutil.dumps(err_obj).encode("utf-8")

@@ -57,6 +57,7 @@ EXPECTED_BAD = {
     # order cycle + lexical re-acquire of a non-reentrant Lock
     "LWC016": 5,  # await + wait_device_ready + upstream HTTP +
     # cross-condition wait + call-mediated blocking, all under a held lock
+    "LWC017": 2,  # to_json_obj + jsonutil.dumps per merged chunk
 }
 
 

@@ -714,6 +714,129 @@ def witness_overhead_record(args) -> dict:
     }
 
 
+def ingest_bounds_record(args) -> dict:
+    """--ingest-bounds: the per-chunk cost of the SSE byte-budget
+    accounting (ISSUE 19 ingest plane), against the same 2% p50
+    inflation discipline as every always-on hot-path feature.
+
+    Two measurements, both device-free:
+
+    1. ns/frame of the SSE parser over a realistic judge-stream frame
+       sequence, uncapped vs capped at the serving defaults
+       (``SSE_MAX_EVENT_BYTES``).  The capped delta is the whole cost
+       of the budget plane on the happy path: one size accumulation and
+       one compare per data line, one residue check per newline-less
+       feed.
+    2. Upstream frames/request on the real host path (J judges x
+       frames/judge), reading the host-path p50 the same engine pays.
+
+    Reported overhead = frames/request x capped-delta ns / host p50 —
+    deterministic, like --metrics-overhead, instead of an A/B of two
+    noisy end-to-end p50s at the sub-1% scale."""
+    from bench import BASELINE_BASIS, make_requests
+    from llm_weighted_consensus_tpu.clients.sse import SSEParser
+    from llm_weighted_consensus_tpu.types.score_request import (
+        ChatCompletionCreateParams as ScoreParams,
+    )
+
+    # -- 1. parser ns/frame, uncapped vs serving-default caps -----------------
+    # frame shapes the judge streams actually carry: a delta chunk, a
+    # finish chunk, a [DONE] terminator (fakes.py sse_frames shape)
+    payload = json.dumps(
+        {
+            "id": "bench",
+            "choices": [
+                {
+                    "index": 0,
+                    "delta": {"content": "I pick a candidate key"},
+                }
+            ],
+        }
+    ).encode()
+    frames = [b"data: " + payload + b"\n\n"] * 2 + [b"data: [DONE]\n\n"]
+    reps = 30_000
+
+    def parse_ns(make_parser) -> float:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            parser = make_parser()
+            for frame in frames:
+                for _event in parser.feed(frame):
+                    pass
+            parser.flush()
+        return (time.perf_counter() - t0) / (reps * len(frames)) * 1e9
+
+    uncapped_ns = parse_ns(SSEParser)
+    capped_ns = parse_ns(
+        lambda: SSEParser(
+            max_buffer_bytes=4 * 1024 * 1024,
+            max_event_bytes=4 * 1024 * 1024,
+        )
+    )
+    overhead_ns = max(0.0, capped_ns - uncapped_ns)
+
+    # -- 2. frames/request and p50 on the real host path ----------------------
+    n_requests = min(args.requests, 20)
+    client, model_json = build_engine(
+        args.judges, args.n, n_requests + 1, args.seed
+    )
+    texts_per_request = make_requests(n_requests, args.n, seed=args.seed)
+
+    async def score_one(texts):
+        params = ScoreParams.from_json_obj(
+            {
+                "messages": [{"role": "user", "content": "pick the best"}],
+                "model": model_json,
+                "choices": texts,
+            }
+        )
+        stream = await client.create_streaming(None, params)
+        return [item async for item in stream]
+
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(score_one(texts_per_request[0]))  # warm
+    total_ms = []
+    for texts in texts_per_request[1:]:
+        t0 = time.perf_counter()
+        loop.run_until_complete(score_one(texts))
+        total_ms.append((time.perf_counter() - t0) * 1e3)
+    loop.close()
+    p50_ms = round(statistics.median(total_ms), 3)
+    # every judge leg streams the scripted frame sequence; the byte
+    # accounting runs once per upstream frame per leg
+    frames_per_request = args.judges * len(frames)
+    overhead_pct = round(
+        frames_per_request * overhead_ns / (p50_ms * 1e6) * 100.0, 4
+    )
+    budget_pct = 2.0
+    record = {
+        "metric": "SSE byte-budget accounting share of host-path p50",
+        "value": overhead_pct,
+        "unit": "%",
+        "budget_pct": budget_pct,
+        "within_budget": overhead_pct <= budget_pct,
+        "uncapped_ns_per_frame": round(uncapped_ns, 1),
+        "capped_ns_per_frame": round(capped_ns, 1),
+        "overhead_ns_per_frame": round(overhead_ns, 1),
+        "frames_per_request": frames_per_request,
+        "host_p50_ms": p50_ms,
+        "requests": n_requests,
+        "judges": args.judges,
+        "n_candidates": args.n,
+        "jax_imported": "jax" in sys.modules,
+        "baseline_basis": BASELINE_BASIS,
+        "note": (
+            "overhead = upstream frames/request x (capped - uncapped) "
+            "parser ns/frame / host p50: the ingest byte budgets "
+            "(SSE_MAX_EVENT_BYTES residue + event accounting, "
+            "clients/sse.py) must stay effectively free on the happy "
+            "path — trips are the exceptional path and priced "
+            "separately in tests/test_hostile_ingest.py"
+        ),
+    }
+    return record
+
+
 def hostpath_record(args, write_budgets: bool = False) -> dict:
     """--hostpath: per-chunk host-path p50 per phase (ingest / merge /
     tally / encode), HOST_FASTPATH unset vs set, over REAL engine
@@ -1062,7 +1185,28 @@ def main() -> None:
             "host path"
         ),
     )
+    ap.add_argument(
+        "--ingest-bounds",
+        action="store_true",
+        help=(
+            "measure the SSE byte-budget accounting (capped vs uncapped "
+            "parser) against the 2%% p50 inflation budget instead of "
+            "the host path"
+        ),
+    )
     args = ap.parse_args()
+
+    if args.ingest_bounds:
+        record = ingest_bounds_record(args)
+        assert record["jax_imported"] is False, (
+            "host bench must stay device-free"
+        )
+        print(json.dumps(record), flush=True)
+        assert record["within_budget"], (
+            f"ingest byte accounting costs {record['value']}% of host "
+            f"p50, budget {record['budget_pct']}%"
+        )
+        return
 
     if args.hostpath:
         record = hostpath_record(args, write_budgets=args.write_budgets)

@@ -103,6 +103,18 @@ CONCURRENCY_MODEL = {
                 "_last_label",
             ),
         },
+        "MemGuard._lock": {
+            "module": "llm_weighted_consensus_tpu/resilience/memguard.py",
+            "kind": "lock",
+            "guards": (
+                "_level",
+                "last_rss",
+                "peak_rss",
+                "soft_trips",
+                "hard_trips",
+                "recoveries",
+            ),
+        },
         "_ShapeGate._cond": {
             "module": "llm_weighted_consensus_tpu/resilience/meshfault.py",
             "kind": "condition",

@@ -46,6 +46,7 @@ from . import (  # noqa: E402
     lwc015_lock_order,
     lwc016_blocking_under_lock,
     lwc017_frame_rebuild_in_merge_loop,
+    lwc018_unbounded_ingest_growth,
 )
 
 ALL_RULES: Tuple[Rule, ...] = (
@@ -66,6 +67,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     lwc015_lock_order.RULE,
     lwc016_blocking_under_lock.RULE,
     lwc017_frame_rebuild_in_merge_loop.RULE,
+    lwc018_unbounded_ingest_growth.RULE,
 )
 
 RULES_BY_NAME = {rule.name: rule for rule in ALL_RULES}

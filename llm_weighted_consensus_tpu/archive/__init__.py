@@ -95,7 +95,16 @@ class InMemoryArchive(Fetcher):
         # put_ballot (ADVICE r3)
         from collections import deque
 
-        self._ballot_orphans = deque()
+        # maxlen bounds the queue STRUCTURE, not just the orphan count:
+        # cids that get archived after queueing stay in the deque as
+        # stale entries (lazily discarded), and a streaming-heavy service
+        # whose completions all get archived would otherwise grow the
+        # deque forever while _n_orphan_ballots sat at zero.  2x the
+        # orphan cap leaves room for a full cap of live orphans plus as
+        # many stale entries; displacement past that is handled (and
+        # counted) explicitly in put_ballot
+        self._ballot_orphans = deque(maxlen=2 * self.MAX_BALLOT_COMPLETIONS)
+        self._orphan_queue_drops = 0
         self._n_orphan_ballots = 0
 
     def _evict_over_cap(self, table: dict) -> None:
@@ -156,6 +165,24 @@ class InMemoryArchive(Fetcher):
         """ScoreClient.ballot_sink-shaped recorder:
         ``ScoreClient(..., ballot_sink=store.put_ballot)``."""
         if completion_id not in self._ballots:
+            if (
+                self._ballot_orphans.maxlen is not None
+                and len(self._ballot_orphans) == self._ballot_orphans.maxlen
+            ):
+                # the append below would silently displace the head; make
+                # the displacement an honest eviction instead — if the
+                # head is still a live orphan its ballots go with it
+                # (it was the oldest candidate anyway), and either way
+                # the drop is counted for /metrics-side forensics
+                dropped = self._ballot_orphans[0]
+                self._orphan_queue_drops += 1
+                if (
+                    dropped != completion_id
+                    and dropped not in self._score
+                    and dropped in self._ballots
+                ):
+                    self._ballots.pop(dropped)
+                    self._n_orphan_ballots -= 1
             self._ballot_orphans.append(completion_id)
             if completion_id not in self._score:
                 self._n_orphan_ballots += 1

@@ -43,6 +43,7 @@ from ..errors import (
     DeadlineExceededError,
     DeserializationError,
     EmptyStreamError,
+    IngestCapError,
     ProviderError,
     ResponseError,
     StreamTimeoutError,
@@ -247,6 +248,8 @@ class DefaultChatClient(ChatClient):
         ctx_handler: Optional[CtxHandler] = None,
         archive_fetcher: Optional[archive_mod.Fetcher] = None,
         resilience=None,
+        judge_stream_max_bytes: int = 0,
+        sse_max_event_bytes: int = 0,
     ) -> None:
         self.transport = transport
         self.api_bases = list(api_bases)
@@ -263,6 +266,13 @@ class DefaultChatClient(ChatClient):
         # ambient retry budget / deadline contextvars are still honored
         # because activating them is itself opt-in upstream.
         self.resilience = resilience
+        # ingest byte budgets (ISSUE 19; 0 = uncapped, the library-level
+        # default — the serving config turns them on): the cumulative
+        # per-leg stream budget doubles as the bad-status/unary body cap,
+        # and the SSE event cap also bounds the parser's newline-less
+        # residue (a line cannot be longer than the event it would form)
+        self.judge_stream_max_bytes = int(judge_stream_max_bytes)
+        self.sse_max_event_bytes = int(sse_max_event_bytes)
         # compile/load the native SSE parser NOW (sync startup context) so
         # make_parser() inside the async decode loop never blocks the loop
         # on a g++ run
@@ -566,6 +576,18 @@ class DefaultChatClient(ChatClient):
                 except asyncio.TimeoutError:
                     yield _timeout_error("first_chunk", started, deadline)
                     return
+                if (
+                    self.judge_stream_max_bytes
+                    and len(raw) > self.judge_stream_max_bytes
+                ):
+                    # a hostile upstream can pad an error body too: the
+                    # leg's byte budget caps the unary read, and the
+                    # oversized body is dropped, not parsed
+                    self._inc("ingest_cap_tripped")
+                    yield IngestCapError(
+                        "unary_body", self.judge_stream_max_bytes, len(raw)
+                    )
+                    return
                 try:
                     parsed = jsonutil.loads(raw.decode("utf-8", errors="replace"))
                 except Exception:
@@ -573,10 +595,16 @@ class DefaultChatClient(ChatClient):
                 yield BadStatusError(resp.status, parsed)
                 return
 
-            # native C++ parser when built (hot loop #1), Python fallback
-            parser = make_parser()
+            # native C++ parser when built (hot loop #1), Python fallback;
+            # the event cap bounds both one event's data payload and the
+            # newline-less residue (giant_line / newline_less_flood)
+            parser = make_parser(
+                max_buffer_bytes=self.sse_max_event_bytes,
+                max_event_bytes=self.sse_max_event_bytes,
+            )
             byte_iter = resp.byte_stream().__aiter__()
             first = True
+            stream_bytes = 0
             pending: list = []
             while True:
                 # per-chunk timeout tiers (client.rs:334-354; defaults
@@ -595,7 +623,12 @@ class DefaultChatClient(ChatClient):
                             byte_iter.__anext__(), timeout
                         )
                     except StopAsyncIteration:
-                        tail = parser.flush()
+                        try:
+                            tail = parser.flush()
+                        except IngestCapError as e:
+                            self._inc("ingest_cap_tripped")
+                            yield e
+                            return
                         if tail is not None and tail != DONE_FRAME:
                             pending.append(tail)
                         if not pending:
@@ -608,7 +641,27 @@ class DefaultChatClient(ChatClient):
                         yield TransportError(str(e))
                         return
                     if data is not None:
-                        pending.extend(parser.feed(data))
+                        # cumulative leg budget (JUDGE_STREAM_MAX_BYTES):
+                        # checked before the parser sees the chunk so a
+                        # flood is dropped, not buffered
+                        stream_bytes += len(data)
+                        if (
+                            self.judge_stream_max_bytes
+                            and stream_bytes > self.judge_stream_max_bytes
+                        ):
+                            self._inc("ingest_cap_tripped")
+                            yield IngestCapError(
+                                "judge_stream",
+                                self.judge_stream_max_bytes,
+                                stream_bytes,
+                            )
+                            return
+                        try:
+                            pending.extend(parser.feed(data))
+                        except IngestCapError as e:
+                            self._inc("ingest_cap_tripped")
+                            yield e
+                            return
                         continue
                 event = pending.pop(0)
                 first = False
@@ -675,7 +728,13 @@ def _breaker_failure(err: ChatError) -> bool:
     deadline expiry is our budget running out, not the upstream's fault."""
     if isinstance(err, DeadlineExceededError):
         return False
-    if isinstance(err, (TransportError, StreamTimeoutError, EmptyStreamError)):
+    if isinstance(
+        err,
+        (TransportError, StreamTimeoutError, EmptyStreamError, IngestCapError),
+    ):
+        # an ingest-cap trip is the upstream misbehaving (giant lines,
+        # newline-less floods, oversized bodies): it counts against the
+        # upstream's health exactly like a transport failure
         return True
     if isinstance(err, BadStatusError):
         return err.code >= 500 or err.code == 429

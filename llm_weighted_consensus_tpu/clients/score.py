@@ -306,6 +306,20 @@ def _message_to_delta(message) -> Delta:
     )
 
 
+def _is_ingest_cap_error(error) -> bool:
+    """True when a per-judge ResponseError carries an ingest-cap trip
+    (IngestCapError, ISSUE 19 byte budgets).  The wire nesting is
+    score -> chat -> {"kind": "ingest_cap", ...}; walked generically so
+    both the stream-opening and mid-stream error paths match."""
+    msg = getattr(error, "message", None)
+    while isinstance(msg, dict):
+        inner = msg.get("error")
+        if isinstance(inner, dict) and inner.get("kind") == "ingest_cap":
+            return True
+        msg = inner
+    return False
+
+
 # ---------------------------------------------------------------------------
 # Stream merge (select_all analog)
 # ---------------------------------------------------------------------------
@@ -759,6 +773,24 @@ class ScoreClient:
                     degraded = True
                     policy.inc("deadline_degraded")
                     obs.annotate(deadline_degraded=True)
+
+        if not degraded:
+            # a judge leg tripped an ingest byte budget (IngestCapError,
+            # clients/chat.py) while other judges voted: the consensus
+            # ships degraded so the final frame keeps the per-judge
+            # cap-trip error entries (the `if not degraded: choice.error
+            # = None` strip below) — same contract as quorum/deadline
+            # degradation, and record_stream refuses to cache it
+            # (all-failed keeps its AllVotesFailed error path below)
+            tail = aggregate.choices[n_choices:]
+            if any(
+                c.error is not None and _is_ingest_cap_error(c.error)
+                for c in tail
+            ) and any(c.delta.vote is not None for c in tail):
+                degraded = True
+                if policy is not None:
+                    policy.inc("ingest_cap_degraded")
+                obs.annotate(ingest_cap_degraded=True)
 
         # tally + all-error detection (client.rs:384-416)
         from decimal import Decimal

@@ -14,6 +14,17 @@ reqwest-eventsource accepts in the reference — chat client.rs:334-434):
 ``data:`` field lines accumulate per event (joined by newline), events end at
 a blank line, ``:`` comment lines and other fields (``event:``/``id:``/
 ``retry:``) are ignored, and both LF and CRLF line endings are accepted.
+
+Byte budgets (ISSUE 19 ingest plane): both parsers accept a
+``max_buffer_bytes`` cap on the newline-less residue and a
+``max_event_bytes`` cap on one event's accumulated ``data:`` payload
+(value bytes plus joining newlines).  A hostile upstream streaming a
+newline-less flood or one giant line trips a typed
+:class:`~..errors.IngestCapError` instead of growing the buffer without
+bound.  Trip semantics are part of the Python/native parity contract
+(tests/test_native.py): events completed before the offending line still
+surface, the oversized state is dropped (buffer/open event cleared), and
+the parser stays usable for subsequent feeds.  ``0`` disables a cap.
 """
 
 from __future__ import annotations
@@ -22,23 +33,50 @@ import ctypes
 import os
 from typing import Iterator, Optional
 
+from ..errors import IngestCapError
+
 
 class SSEParser:
     """Push bytes in, pull decoded event data strings out."""
 
-    def __init__(self) -> None:
+    def __init__(
+        self, max_buffer_bytes: int = 0, max_event_bytes: int = 0
+    ) -> None:
         self._buffer = bytearray()
         self._data_lines: list = []
+        # accumulated byte size of the open event (value bytes + joining
+        # newlines) — the quantity max_event_bytes caps.  Counted on the
+        # raw bytes, pre-decode, so the native twin trips on the exact
+        # same boundary.
+        self._event_bytes = 0
+        self.max_buffer_bytes = int(max_buffer_bytes)
+        self.max_event_bytes = int(max_event_bytes)
+        self.cap_trips = 0
         # events dispatched over this parser's lifetime — read by the
         # chat client at stream end as a judge-span trace attribute
         self.events_parsed = 0
 
     def feed(self, data: bytes) -> Iterator[str]:
-        """Consume a chunk of bytes; yield completed event payloads."""
+        """Consume a chunk of bytes; yield completed event payloads.
+
+        Raises :class:`IngestCapError` (after yielding any events that
+        completed first) when a byte budget trips."""
         self._buffer.extend(data)
         while True:
             nl = self._buffer.find(b"\n")
             if nl < 0:
+                if (
+                    self.max_buffer_bytes
+                    and len(self._buffer) > self.max_buffer_bytes
+                ):
+                    observed = len(self._buffer)
+                    # drop the oversized residue: the parser must stay
+                    # bounded AND usable if the caller keeps feeding
+                    self._buffer = bytearray()
+                    self.cap_trips += 1
+                    raise IngestCapError(
+                        "sse_buffer", self.max_buffer_bytes, observed
+                    )
                 return
             line = bytes(self._buffer[:nl])
             del self._buffer[: nl + 1]
@@ -54,6 +92,7 @@ class SSEParser:
             if self._data_lines:
                 event = "\n".join(self._data_lines)
                 self._data_lines = []
+                self._event_bytes = 0
                 self.events_parsed += 1
                 return event
             return None
@@ -63,6 +102,19 @@ class SSEParser:
         if value.startswith(b" "):
             value = value[1:]
         if field == b"data":
+            grown = self._event_bytes + len(value) + (
+                1 if self._data_lines else 0
+            )
+            if self.max_event_bytes and grown > self.max_event_bytes:
+                # drop the oversized open event; the offending line is
+                # already consumed, so parsing can resume cleanly
+                self._data_lines = []
+                self._event_bytes = 0
+                self.cap_trips += 1
+                raise IngestCapError(
+                    "sse_event", self.max_event_bytes, grown
+                )
+            self._event_bytes = grown
             self._data_lines.append(value.decode("utf-8", errors="replace"))
         # other fields (event/id/retry) are ignored
         return None
@@ -84,6 +136,7 @@ class SSEParser:
         if self._data_lines:
             event = "\n".join(self._data_lines)
             self._data_lines = []
+            self._event_bytes = 0
             self.events_parsed += 1
             return event
         return None
@@ -93,6 +146,10 @@ class SSEParser:
 
 _native_lib = None
 _native_tried = False
+
+# trip kinds returned by sse_parser_take_trip (native/sse_parser.cpp)
+_TRIP_BUFFER = 1
+_TRIP_EVENT = 2
 
 
 def load_native_library():
@@ -131,6 +188,20 @@ def load_native_library():
         ]
         lib.sse_parser_flush.restype = ctypes.c_size_t
         lib.sse_parser_flush.argtypes = [ctypes.c_void_p]
+        # byte-budget ABI (ISSUE 19); a prebuilt .so predating the caps
+        # raises AttributeError here, disabling the native path entirely
+        # rather than serving an uncappable parser
+        lib.sse_parser_set_caps.restype = None
+        lib.sse_parser_set_caps.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+        ]
+        lib.sse_parser_take_trip.restype = ctypes.c_int
+        lib.sse_parser_take_trip.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
         _native_lib = lib
     except Exception:
         _native_lib = None
@@ -139,13 +210,26 @@ def load_native_library():
 
 class NativeSSEParser:
     """ctypes wrapper over native/sse_parser.cpp — same interface and frame
-    semantics as ``SSEParser`` (parity-tested in tests/test_native.py)."""
+    semantics as ``SSEParser``, caps included (parity-tested in
+    tests/test_native.py)."""
 
-    def __init__(self, lib=None) -> None:
+    def __init__(
+        self,
+        lib=None,
+        max_buffer_bytes: int = 0,
+        max_event_bytes: int = 0,
+    ) -> None:
         self._lib = lib or load_native_library()
         if self._lib is None:
             raise RuntimeError("native SSE parser unavailable")
         self._handle = self._lib.sse_parser_new()
+        self.max_buffer_bytes = int(max_buffer_bytes)
+        self.max_event_bytes = int(max_event_bytes)
+        if self.max_buffer_bytes or self.max_event_bytes:
+            self._lib.sse_parser_set_caps(
+                self._handle, self.max_buffer_bytes, self.max_event_bytes
+            )
+        self.cap_trips = 0
         self.events_parsed = 0  # same contract as SSEParser
 
     def _drain(self) -> Iterator[str]:
@@ -161,14 +245,38 @@ class NativeSSEParser:
                 "utf-8", errors="replace"
             )
 
+    def _raise_if_tripped(self) -> None:
+        observed = ctypes.c_size_t()
+        kind = self._lib.sse_parser_take_trip(
+            self._handle, ctypes.byref(observed)
+        )
+        if kind == 0:
+            return
+        self.cap_trips += 1
+        if kind == _TRIP_BUFFER:
+            raise IngestCapError(
+                "sse_buffer", self.max_buffer_bytes, observed.value
+            )
+        raise IngestCapError(
+            "sse_event", self.max_event_bytes, observed.value
+        )
+
+    def _drain_then_trip(self) -> Iterator[str]:
+        # events completed before the offending line surface first, then
+        # the trip raises — byte-identical to the Python generator, which
+        # yields as it parses and raises at the offending line
+        yield from self._drain()
+        self._raise_if_tripped()
+
     def feed(self, data: bytes) -> Iterator[str]:
         self._lib.sse_parser_feed(self._handle, data, len(data))
-        return self._drain()
+        return self._drain_then_trip()
 
     def flush(self) -> Optional[str]:
-        if self._lib.sse_parser_flush(self._handle) == 0:
-            return None
-        return next(self._drain(), None)
+        n = self._lib.sse_parser_flush(self._handle)
+        event = next(self._drain(), None) if n else None
+        self._raise_if_tripped()
+        return event
 
     def close(self) -> None:
         if self._handle is not None:
@@ -182,10 +290,18 @@ class NativeSSEParser:
             pass
 
 
-def make_parser():
+def make_parser(max_buffer_bytes: int = 0, max_event_bytes: int = 0):
     """The serving path's parser factory: native when available, else the
-    pure-Python implementation (identical semantics either way)."""
+    pure-Python implementation (identical semantics either way).  Caps of
+    0 disable the corresponding byte budget."""
     lib = load_native_library()
     if lib is not None:
-        return NativeSSEParser(lib)
-    return SSEParser()
+        return NativeSSEParser(
+            lib,
+            max_buffer_bytes=max_buffer_bytes,
+            max_event_bytes=max_event_bytes,
+        )
+    return SSEParser(
+        max_buffer_bytes=max_buffer_bytes,
+        max_event_bytes=max_event_bytes,
+    )

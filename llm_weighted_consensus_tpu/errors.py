@@ -165,6 +165,31 @@ class StreamTimeoutError(ChatError):
         self.elapsed_ms = elapsed_ms
 
 
+class IngestCapError(ChatError):
+    """A byte-budget cap tripped while ingesting upstream bytes.
+
+    ``what`` names the tripped budget: ``sse_buffer`` (newline-less
+    residue in the SSE parser), ``sse_event`` (one event's accumulated
+    ``data:`` payload), ``judge_stream`` (a judge leg's cumulative
+    stream budget, JUDGE_STREAM_MAX_BYTES) or ``unary_body`` (a
+    non-streaming body read).  502: the upstream is misbehaving, not
+    us — and like any upstream failure the trip counts against that
+    upstream's breaker (clients/chat.py ``_breaker_failure``)."""
+
+    def __init__(self, what: str, limit_bytes: int, observed_bytes: int):
+        super().__init__(
+            "ingest_cap",
+            (
+                f"{what} exceeded {limit_bytes} bytes "
+                f"(observed {observed_bytes})"
+            ),
+            502,
+        )
+        self.what = what
+        self.limit_bytes = limit_bytes
+        self.observed_bytes = observed_bytes
+
+
 class BreakerOpenError(ChatError):
     """Attempt refused locally: the upstream's circuit breaker is open."""
 

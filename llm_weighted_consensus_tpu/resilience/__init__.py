@@ -24,9 +24,14 @@ one its own module with a pure, clock-injectable core:
   weight has voted that the stragglers cannot flip the argmax, they are
   cancelled and the final frame ships with ``degraded: true``;
 * ``faults``    — a deterministic, seeded fault-injection ``Transport``
-  (connect refusal, 5xx, stalls, malformed SSE, truncation) so every
-  degradation path above is exercised in tests instead of discovered in
-  production;
+  (connect refusal, 5xx, stalls, malformed SSE, truncation, plus the
+  hostile-ingest kinds: giant lines, newline-less floods, oversized
+  unary bodies, binary garbage) so every degradation path above is
+  exercised in tests instead of discovered in production;
+* ``memguard``  — a host memory governor sampling RSS against soft/hard
+  watermarks: soft pressure shrinks cache/trace budgets and the AIMD
+  limit, hard pressure sheds new work (``shed_reason: "memory"``) and
+  flags ``degraded_mem`` on /readyz, recovering hysteretically;
 * ``admission`` — overload protection at the gateway door: a hard
   in-flight cap plus an AIMD/gradient adaptive limit, shedding excess
   work with ``503 + Retry-After + shed_reason`` instead of queueing it
@@ -63,6 +68,7 @@ from .faults import (  # noqa: F401
     JudgeBiasPlan,
 )
 from .hedge import HedgePolicy, LatencyTracker  # noqa: F401
+from .memguard import MemGuard  # noqa: F401
 from .meshfault import (  # noqa: F401
     DeviceFaultPlan,
     InjectedHangError,
@@ -129,6 +135,7 @@ __all__ = [
     "InjectedTransientError",
     "JudgeBiasPlan",
     "LatencyTracker",
+    "MemGuard",
     "MeshFaultManager",
     "QuorumTracker",
     "ResiliencePolicy",

@@ -69,12 +69,18 @@ class AdmissionController:
         *,
         clock: Callable[[], float] = time.monotonic,
         device_gate: Optional[Callable[[], Optional[str]]] = None,
+        mem_gate: Optional[Callable[[], Optional[str]]] = None,
     ) -> None:
         self.config = config
         self.clock = clock
         # extra shed policy for device-dependent work (wired to the
         # watchdog): returns a shed reason, or None to admit
         self.device_gate = device_gate
+        # memory-pressure shed policy (wired to MemGuard.gate): unlike
+        # device_gate it applies to ALL non-exempt work — host-only
+        # endpoints allocate too, and under hard memory pressure every
+        # new request is one the OOM killer might answer instead of us
+        self.mem_gate = mem_gate
         self.inflight = 0
         self.draining = False
         # the adaptive limit lives under the hard cap; without the
@@ -97,6 +103,10 @@ class AdmissionController:
         reason."""
         if self.draining:
             return self._shed("draining")
+        if self.mem_gate is not None:
+            reason = self.mem_gate()
+            if reason is not None:
+                return self._shed(reason)
         if self.device_gate is not None and device_work:
             reason = self.device_gate()
             if reason is not None:

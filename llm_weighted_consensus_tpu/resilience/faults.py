@@ -1,7 +1,7 @@
 """Deterministic fault injection at the Transport seam.
 
 ``FaultInjectionTransport`` wraps any real ``Transport`` and, per
-request, consults a seeded ``FaultPlan`` for one of six fault kinds —
+request, consults a seeded ``FaultPlan`` for one of ten fault kinds —
 the failure modes an OpenAI-compatible SSE upstream actually exhibits:
 
 * ``connect``      — connection refused (``TransportError`` before any
@@ -15,6 +15,22 @@ the failure modes an OpenAI-compatible SSE upstream actually exhibits:
 * ``malformed``    — an invalid SSE data frame injected mid-stream
   (exercises per-frame decode-error tolerance);
 * ``truncate``     — stream ends early without ``[DONE]``.
+
+Hostile-ingest kinds (ISSUE 19 — the byte-budget plane's adversaries,
+all sized by the ``flood_bytes`` plan knob, default 8 MiB):
+
+* ``giant_line``         — one complete, terminated ``data:`` line of
+  ``flood_bytes`` payload injected after the first real chunk (trips
+  the SSE event byte budget);
+* ``newline_less_flood`` — ``flood_bytes`` of newline-less bytes
+  streamed in chunks, then the stream cuts (trips the SSE residue
+  buffer cap or the per-judge stream budget, whichever is tighter);
+* ``oversized_unary``    — a synthetic bad-status response whose body
+  is ``flood_bytes`` long (trips the unary body cap on the bad-status
+  read path);
+* ``binary_garbage``     — seeded random binary chunks injected
+  mid-stream (exercises decode tolerance: garbage lines are not
+  ``data:`` fields, mid-UTF-8 cuts must not crash the decoder).
 
 Determinism: one ``random.Random(seed)`` drawn once per request in
 request order, so a single-threaded test driving requests in a fixed
@@ -40,10 +56,29 @@ STALL_FIRST = "stall_first"
 STALL_MID = "stall_mid"
 MALFORMED = "malformed"
 TRUNCATE = "truncate"
+GIANT_LINE = "giant_line"
+NEWLINE_LESS_FLOOD = "newline_less_flood"
+OVERSIZED_UNARY = "oversized_unary"
+BINARY_GARBAGE = "binary_garbage"
 
-KINDS = (CONNECT, BAD_STATUS, STALL_FIRST, STALL_MID, MALFORMED, TRUNCATE)
+KINDS = (
+    CONNECT,
+    BAD_STATUS,
+    STALL_FIRST,
+    STALL_MID,
+    MALFORMED,
+    TRUNCATE,
+    GIANT_LINE,
+    NEWLINE_LESS_FLOOD,
+    OVERSIZED_UNARY,
+    BINARY_GARBAGE,
+)
 
 _MALFORMED_FRAME = b"data: {this is not json\n\n"
+
+# chunk size hostile floods stream in — large enough that an 8 MiB flood
+# is ~128 chunks, small enough to exercise incremental byte accounting
+_FLOOD_CHUNK = 64 * 1024
 
 
 def iter_plan_spec(spec: str, label: str):
@@ -70,6 +105,7 @@ class FaultPlan:
         seed: int = 0,
         probabilities: Optional[Dict[str, float]] = None,
         stall_ms: float = 100.0,
+        flood_bytes: int = 8 << 20,
         script: Optional[List[Optional[str]]] = None,
     ) -> None:
         self.seed = int(seed)
@@ -78,6 +114,7 @@ class FaultPlan:
             kind: float((probabilities or {}).get(kind, 0.0)) for kind in KINDS
         }
         self.stall_ms = float(stall_ms)
+        self.flood_bytes = max(1, int(flood_bytes))
         self._script = list(script) if script is not None else None
         self._script_pos = 0
         self.requests = 0
@@ -95,12 +132,14 @@ class FaultPlan:
     def parse(cls, spec: str) -> "FaultPlan":
         """Parse a ``FAULT_PLAN`` env spec.
 
-        Comma-separated ``key=value``: ``seed``, ``stall_ms``, one key
-        per fault kind with its probability, or ``script=a|b|ok|c``
-        (``ok``/empty = healthy slot).
+        Comma-separated ``key=value``: ``seed``, ``stall_ms``,
+        ``flood_bytes`` (hostile-ingest payload size), one key per fault
+        kind with its probability, or ``script=a|b|ok|c`` (``ok``/empty
+        = healthy slot).
         """
         seed = 0
         stall_ms = 100.0
+        flood_bytes = 8 << 20
         probs: Dict[str, float] = {}
         script: Optional[List[Optional[str]]] = None
         for key, value in iter_plan_spec(spec, "FAULT_PLAN"):
@@ -108,6 +147,8 @@ class FaultPlan:
                 seed = int(value)
             elif key == "stall_ms":
                 stall_ms = float(value)
+            elif key == "flood_bytes":
+                flood_bytes = int(value)
             elif key == "script":
                 script = [
                     None if slot in ("", "ok") else slot
@@ -120,7 +161,13 @@ class FaultPlan:
                 probs[key] = float(value)
             else:
                 raise ValueError(f"FAULT_PLAN: unknown key {key!r}")
-        return cls(seed=seed, probabilities=probs, stall_ms=stall_ms, script=script)
+        return cls(
+            seed=seed,
+            probabilities=probs,
+            stall_ms=stall_ms,
+            flood_bytes=flood_bytes,
+            script=script,
+        )
 
     def next_fault(self) -> Optional[str]:
         """The fault for the next request (None = healthy)."""
@@ -314,13 +361,43 @@ class _SyntheticBadStatus:
         pass
 
 
+class _SyntheticOversizedBody:
+    """A bad-status response whose body is a ``flood_bytes`` blob — the
+    hostile upstream that answers a failed request with a memory bomb
+    instead of an error envelope (trips the unary body cap)."""
+
+    status = 503
+
+    def __init__(self, n_bytes: int) -> None:
+        self._n_bytes = n_bytes
+
+    async def read_body(self) -> bytes:
+        return b"x" * self._n_bytes
+
+    async def byte_stream(self) -> AsyncIterator[bytes]:
+        return
+        yield b""  # pragma: no cover — makes this an async generator
+
+    async def close(self) -> None:
+        pass
+
+
 class _FaultedResponse:
     """Delegates to the real response, perturbing the byte stream."""
 
-    def __init__(self, inner, fault: Optional[str], stall_s: float) -> None:
+    def __init__(
+        self,
+        inner,
+        fault: Optional[str],
+        stall_s: float,
+        flood_bytes: int = 8 << 20,
+        garbage_rng: Optional[random.Random] = None,
+    ) -> None:
         self._inner = inner
         self._fault = fault
         self._stall_s = stall_s
+        self._flood_bytes = flood_bytes
+        self._garbage_rng = garbage_rng
         self.status = inner.status
 
     async def read_body(self) -> bytes:
@@ -335,8 +412,30 @@ class _FaultedResponse:
                 await asyncio.sleep(self._stall_s)
             yield data
             seen += 1
-            if self._fault == MALFORMED and seen == 1:
-                yield _MALFORMED_FRAME
+            if seen == 1:
+                if self._fault == MALFORMED:
+                    yield _MALFORMED_FRAME
+                elif self._fault == GIANT_LINE:
+                    # one complete, terminated data line: the parser sees
+                    # the newline and trips the *event* budget (not the
+                    # residue cap) on a deterministic byte boundary
+                    yield b"data: " + b"A" * self._flood_bytes + b"\n\n"
+                elif self._fault == NEWLINE_LESS_FLOOD:
+                    # newline-less chunks accumulate as parser residue
+                    # (or burn the per-judge stream budget), then the
+                    # stream cuts without [DONE]
+                    remaining = self._flood_bytes
+                    while remaining > 0:
+                        n = min(remaining, _FLOOD_CHUNK)
+                        yield b"B" * n
+                        remaining -= n
+                    return
+                elif self._fault == BINARY_GARBAGE:
+                    # seeded random chunks: newlines land anywhere, lines
+                    # are not data: fields, UTF-8 cuts mid-sequence — the
+                    # decode-tolerance gauntlet
+                    for _ in range(4):
+                        yield self._garbage_rng.randbytes(4096)
             if self._fault == TRUNCATE and seen >= 1:
                 return
 
@@ -359,10 +458,26 @@ class FaultInjectionTransport:
             raise TransportError("fault-injected connection refused")
         if fault == BAD_STATUS:
             return _SyntheticBadStatus()
+        if fault == OVERSIZED_UNARY:
+            return _SyntheticOversizedBody(self.plan.flood_bytes)
         resp = await self.inner.post_sse(url, headers, body)
         if fault is None:
             return resp
-        return _FaultedResponse(resp, fault, self.plan.stall_ms / 1000.0)
+        garbage_rng = None
+        if fault == BINARY_GARBAGE:
+            # fresh per-request rng keyed on (seed, request ordinal): the
+            # garbage is deterministic without disturbing the plan rng's
+            # next_fault draw sequence
+            garbage_rng = random.Random(
+                (self.plan.seed << 16) ^ self.plan.requests
+            )
+        return _FaultedResponse(
+            resp,
+            fault,
+            self.plan.stall_ms / 1000.0,
+            flood_bytes=self.plan.flood_bytes,
+            garbage_rng=garbage_rng,
+        )
 
     async def close(self) -> None:
         await self.inner.close()

@@ -774,6 +774,11 @@ def build_service(
         other_chunk_timeout_ms=config.other_chunk_timeout_millis,
         archive_fetcher=store,
         resilience=resilience,
+        # hostile-upstream byte budgets (JUDGE_STREAM_MAX_BYTES /
+        # SSE_MAX_EVENT_BYTES): cap trips degrade the judge leg instead
+        # of growing host memory without bound
+        judge_stream_max_bytes=config.judge_stream_max_bytes,
+        sse_max_event_bytes=config.sse_max_event_bytes,
     )
     model_registry = registry.InMemoryModelRegistry()
     # --fake-upstream is demo/test mode: synthetic embedder params are
@@ -1112,8 +1117,16 @@ def build_service(
                 return "device_unhealthy"
         return None
 
+    # MEMGUARD: host memory governor (resilience/memguard.py) — soft
+    # pressure shrinks cache/trace budgets and decays the AIMD limit,
+    # hard pressure sheds at admission with shed_reason "memory".  None
+    # when disabled or when /proc/meminfo is unreadable and no explicit
+    # watermarks were given (the governor never guesses)
+    memguard = config.memguard()
     admission = AdmissionController(
-        config.admission_config(), device_gate=_device_gate
+        config.admission_config(),
+        device_gate=_device_gate,
+        mem_gate=memguard.gate if memguard is not None else None,
     )
     if meshfault is not None:
         # every shape change rescales admission (hard cap + AIMD limit)
@@ -1216,11 +1229,22 @@ def build_service(
     # by DRAIN_TIMEOUT_MILLIS), flushes the cache disk tier once
     from .lifecycle import Lifecycle
 
+    # TRACE_*: request tracing (obs/); None preserves untraced behavior.
+    # Hoisted so the memory governor can shrink the ring under pressure
+    trace_sink = config.trace_sink()
+    if memguard is not None:
+        memguard.govern(
+            caches=[c for c in (score_cache, embed_cache) if c is not None],
+            sinks=[s for s in (trace_sink,) if s is not None],
+            admission=admission,
+        )
+        memguard.start()
     lifecycle = Lifecycle(
         admission=admission,
         batcher=batcher,
         caches=(score_cache, embed_cache),
         watchdog=watchdog,
+        memguard=memguard,
         meshfault=meshfault,
         drain_timeout_ms=config.drain_timeout_millis,
         # FLEET_*: the drain hands this replica's hot set to its
@@ -1242,12 +1266,15 @@ def build_service(
         lifecycle=lifecycle,
         watchdog=watchdog,
         meshfault=meshfault,
-        # TRACE_*: request tracing (obs/); None preserves untraced behavior
-        trace_sink=config.trace_sink(),
+        trace_sink=trace_sink,
         ledger=ledger,
         fleet=fleet,
         # HOST_FASTPATH: splice-serialized SSE frames (serve/frames.py)
         host_fastpath=config.host_fastpath,
+        memguard=memguard,
+        # MAX_BODY_BYTES: aiohttp client_max_size — every route,
+        # /fleet/v1 included, 413s render the payload_too_large envelope
+        max_body_bytes=config.max_body_bytes,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables

@@ -267,7 +267,40 @@ byte for byte):
   (and is never cached).  ``0`` = always wait for the full panel.
 * ``FAULT_PLAN`` — chaos-run fault injection at the transport seam,
   e.g. ``seed=42,connect=0.1,5xx=0.1,stall_first=0.1,stall_ms=200``
-  (resilience/faults.py).  Never set in production.
+  (resilience/faults.py); the hostile-ingest kinds (``giant_line``,
+  ``newline_less_flood``, ``oversized_unary``, ``binary_garbage``)
+  size their payloads with ``flood_bytes`` (default 8 MiB).  Never set
+  in production.
+
+Hostile input & memory pressure (clients/sse.py byte budgets,
+resilience/memguard.py — on by default, 0 disables each cap):
+
+* ``JUDGE_STREAM_MAX_BYTES`` — cumulative byte budget for one judge's
+  SSE stream leg; also caps the body read on a non-200 upstream
+  response.  A trip surfaces as a per-judge ``ingest_cap`` error entry
+  in a degraded (never-cached) final frame, counts against that
+  upstream's breaker, and is hedgeable like any first-chunk failure.
+  Default 33554432 (32 MiB); ``0`` = uncapped.
+* ``SSE_MAX_EVENT_BYTES`` — byte cap on one SSE event's accumulated
+  ``data:`` payload AND on the parser's newline-less buffered residue
+  (one knob bounds both, Python and native parsers identically).
+  Default 4194304 (4 MiB); ``0`` = uncapped.
+* ``MAX_BODY_BYTES`` — gateway request-body cap (aiohttp
+  ``client_max_size``, /fleet/v1 included); oversized requests get a
+  structured ``413 {"kind": "payload_too_large"}`` envelope.  Default
+  1048576 (1 MiB); ``0`` = aiohttp's own default cap.
+* ``MEMGUARD`` — ``1`` (default) runs the host memory governor: RSS
+  sampled each ``MEMGUARD_INTERVAL_MILLIS`` against soft/hard
+  watermarks.  Soft pressure shrinks the cache byte budgets, trace
+  ring and AIMD admission limit (restored on recovery); hard pressure
+  sheds new non-exempt work (``503 shed_reason: memory``) and flags
+  ``degraded_mem`` on /readyz (still 200).  Recovery is hysteretic.
+  ``0`` disables.
+* ``MEM_SOFT_BYTES`` / ``MEM_HARD_BYTES`` — the watermarks; ``0``
+  (default) = auto at 80% / 90% of /proc/meminfo MemTotal (the
+  governor disables itself when MemTotal is unreadable).
+* ``MEMGUARD_INTERVAL_MILLIS`` — governor sampling period.
+  Default 1000.
 
 Resilience counters + breaker states surface as the ``resilience``
 section of ``GET /metrics``.
@@ -723,6 +756,21 @@ class Config:
     resilience_quorum: float = 0.0  # 0 = wait for the full panel
     # chaos-run fault injection spec (resilience/faults.py); None = off
     fault_plan: Optional[str] = None
+    # ingest byte budgets (clients/sse.py, clients/chat.py): per-judge
+    # cumulative stream budget (doubles as the non-200 body-read cap)
+    # and the SSE event/residue cap.  Library defaults are 0/off; the
+    # SERVING layer turns them on here — 0 disables a cap explicitly
+    judge_stream_max_bytes: int = 32 * 1024 * 1024
+    sse_max_event_bytes: int = 4 * 1024 * 1024
+    # gateway request-body cap -> aiohttp client_max_size (413 with a
+    # structured payload_too_large envelope); 0 = aiohttp's default
+    max_body_bytes: int = 1024 * 1024
+    # host memory governor (resilience/memguard.py): soft/hard RSS
+    # watermarks (0 = auto from MemTotal), sampling period, on/off
+    memguard_enabled: bool = True
+    mem_soft_bytes: int = 0
+    mem_hard_bytes: int = 0
+    memguard_interval_millis: float = 1000.0
     # overload protection (resilience/admission.py): hard in-flight cap
     # (0 = no shedding, gauge only), batcher queue bound (0 = unbounded),
     # and the AIMD/gradient adaptive limit under the cap
@@ -934,6 +982,19 @@ class Config:
             resilience_deadline_millis=get_f("RESILIENCE_DEADLINE_MILLIS", 0),
             resilience_quorum=get_f("RESILIENCE_QUORUM", 0),
             fault_plan=env.get("FAULT_PLAN"),
+            judge_stream_max_bytes=_non_negative_int(
+                env, "JUDGE_STREAM_MAX_BYTES", 32 * 1024 * 1024
+            ),
+            sse_max_event_bytes=_non_negative_int(
+                env, "SSE_MAX_EVENT_BYTES", 4 * 1024 * 1024
+            ),
+            max_body_bytes=_non_negative_int(
+                env, "MAX_BODY_BYTES", 1024 * 1024
+            ),
+            memguard_enabled=env_truthy(env.get("MEMGUARD", "1")),
+            mem_soft_bytes=_non_negative_int(env, "MEM_SOFT_BYTES", 0),
+            mem_hard_bytes=_non_negative_int(env, "MEM_HARD_BYTES", 0),
+            memguard_interval_millis=get_f("MEMGUARD_INTERVAL_MILLIS", 1000),
             admission_max_inflight=_non_negative_int(
                 env, "ADMISSION_MAX_INFLIGHT", 0
             ),
@@ -1023,6 +1084,21 @@ class Config:
                 f"ADMISSION_LATENCY_FACTOR={config.admission_latency_factor} "
                 "must be > 1 (it multiplies the latency baseline to form "
                 "the congestion threshold)"
+            )
+        if (
+            config.mem_soft_bytes > 0
+            and config.mem_hard_bytes > 0
+            and config.mem_hard_bytes < config.mem_soft_bytes
+        ):
+            raise ValueError(
+                f"MEM_HARD_BYTES={config.mem_hard_bytes} must be >= "
+                f"MEM_SOFT_BYTES={config.mem_soft_bytes}: the hard "
+                "watermark sheds work the soft watermark only degrades"
+            )
+        if config.memguard_interval_millis <= 0:
+            raise ValueError(
+                f"MEMGUARD_INTERVAL_MILLIS={config.memguard_interval_millis}"
+                " must be > 0 (the governor's RSS sampling period)"
             )
         if config.drain_timeout_millis < 0:
             raise ValueError(
@@ -1258,6 +1334,21 @@ class Config:
         from ..resilience import FaultPlan
 
         return FaultPlan.parse(self.fault_plan)
+
+    def memguard(self):
+        """The configured MemGuard, or None when MEMGUARD=0 or an auto
+        watermark is needed but MemTotal is unreadable (the governor
+        never guesses — resilience_policy() discipline)."""
+        if not self.memguard_enabled:
+            return None
+        from ..resilience.memguard import MemGuard, resolve_watermarks
+
+        marks = resolve_watermarks(self.mem_soft_bytes, self.mem_hard_bytes)
+        if marks is None:
+            return None
+        return MemGuard(
+            marks[0], marks[1], interval_ms=self.memguard_interval_millis
+        )
 
     def device_fault_injection_plan(self):
         """Parsed DEVICE_FAULT_PLAN, or None (chaos/drill runs only)."""

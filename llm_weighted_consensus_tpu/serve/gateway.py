@@ -54,6 +54,39 @@ SSE_HEADERS = {
     "cache-control": "no-cache",
 }
 
+# aiohttp's own default request-body cap (client_max_size), used when
+# MAX_BODY_BYTES=0 — the gateway never runs uncapped
+_AIOHTTP_DEFAULT_BODY_BYTES = 1024 ** 2
+
+
+def payload_cap_middleware():
+    """Render aiohttp's 413 (client_max_size exceeded) as the uniform
+    ``{code, message}`` envelope with a machine-readable kind, instead
+    of the stock HTML error page.  The body read that trips the cap
+    happens inside the handler (``await request.text()``), so this sits
+    anywhere above the handlers in the middleware chain."""
+
+    @web.middleware
+    async def _mw(request, handler):
+        try:
+            return await handler(request)
+        except web.HTTPRequestEntityTooLarge:
+            obs.annotate(payload_too_large=True)
+            return web.Response(
+                status=413,
+                text=jsonutil.dumps(
+                    with_trace_id(
+                        {
+                            "code": 413,
+                            "message": {"kind": "payload_too_large"},
+                        }
+                    )
+                ),
+                content_type="application/json",
+            )
+
+    return _mw
+
 
 def _error_response(e: Exception) -> web.Response:
     if isinstance(e, OverloadedError):
@@ -592,10 +625,12 @@ def build_app(
     ledger=None,
     fleet=None,
     host_fastpath: bool = False,
+    memguard=None,
+    max_body_bytes: int = 0,
 ) -> web.Application:
     metrics = metrics or Metrics()
     register_resilience(metrics, resilience, fault_plan)
-    register_overload(metrics, admission, watchdog, lifecycle)
+    register_overload(metrics, admission, watchdog, lifecycle, memguard)
     register_performance(metrics, _roofline_gauge(embedder))
     register_quality(metrics, ledger)
     if embedder is not None and batcher is None:
@@ -644,6 +679,10 @@ def build_app(
         middlewares.append(trace_middleware(trace_sink))
         metrics.register_provider("traces", trace_sink.snapshot)
     middlewares.append(middleware(metrics))
+    # inside metrics (413s are observable per route), outside admission
+    # (an oversized body should not burn an admission slot's error
+    # accounting on its way out)
+    middlewares.append(payload_cap_middleware())
     if admission is not None:
         # inside metrics (sheds are observable per route), outside the
         # deadline stamp (shed work should not even start a budget)
@@ -661,7 +700,15 @@ def build_app(
             deadline_ms = 0.0
 
         middlewares.append(deadline_middleware(_HeaderOnlyDeadline()))
-    app = web.Application(middlewares=middlewares)
+    # MAX_BODY_BYTES → aiohttp's own pre-parse body cap; covers every
+    # route on this app, /fleet/v1 included.  0 keeps aiohttp's default
+    # rather than lifting the cap — the gateway never runs unbounded
+    app = web.Application(
+        middlewares=middlewares,
+        client_max_size=(
+            max_body_bytes if max_body_bytes > 0 else _AIOHTTP_DEFAULT_BODY_BYTES
+        ),
+    )
     app[METRICS_KEY] = metrics
     if fleet is not None:
         # the replica-to-replica surface (/fleet/v1/*, fleet/handlers.py)

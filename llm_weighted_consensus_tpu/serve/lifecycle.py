@@ -41,6 +41,7 @@ class Lifecycle:
         batcher=None,
         caches=(),
         watchdog=None,
+        memguard=None,
         meshfault=None,
         fleet=None,
         drain_timeout_ms: float = 10000.0,
@@ -53,6 +54,11 @@ class Lifecycle:
         # everything the final dispatches produced
         self.caches = [c for c in caches if c is not None]
         self.watchdog = watchdog
+        # host memory governor (resilience/memguard.py): pressure keeps
+        # /readyz at 200 with a degraded_mem flag — shedding under hard
+        # pressure is admission's job, and a replica recovering memory
+        # is still the best home for its in-flight work
+        self.memguard = memguard
         # mesh fault domains (resilience/meshfault.py): a downsized-but-
         # serving mesh stays READY — /readyz reports 200 with a
         # degraded_mesh flag, never 503, because proportional capacity
@@ -143,6 +149,8 @@ class Lifecycle:
             self.cache_flushes += 1
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.memguard is not None:
+            self.memguard.stop()
         self.state = STOPPED
         self.drained_clean = clean
         self.drain_elapsed_ms = (self.clock() - t0) * 1e3
@@ -187,6 +195,14 @@ def health_handlers(lifecycle: Optional[Lifecycle]):
                 # /metrics section) for the degradation
                 body["degraded_mesh"] = True
                 body["mesh_shape"] = list(mf.current_shape)
+            mg = lifecycle.memguard
+            if mg is not None and mg.degraded:
+                # still 200 for the same reason as degraded_mesh: soft
+                # pressure serves everything, hard pressure sheds at
+                # admission with a retryable 503 — either way in-flight
+                # work is finishing and the balancer should keep probing
+                body["degraded_mem"] = True
+                body["mem_level"] = mg.snapshot()["level"]
             if lifecycle.fleet is not None:
                 # the balancer-facing view of fleet membership: who this
                 # replica is, the roster it sees, and the key-space share

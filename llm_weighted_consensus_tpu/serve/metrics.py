@@ -50,6 +50,7 @@ KNOWN_SECTIONS = (
     "ledger",
     "lock_witness",
     "fleet",
+    "memguard",
 )
 
 # Every Prometheus family the text exposition may emit.  Same contract
@@ -73,6 +74,9 @@ KNOWN_PROM_FAMILIES = (
     "lwc_fleet_peer_fetches",
     "lwc_fleet_leases",
     "lwc_fleet_disruptions",
+    "lwc_memguard_rss_bytes",
+    "lwc_memguard_level",
+    "lwc_memguard_trips",
 )
 
 
@@ -370,6 +374,39 @@ def render_prometheus(metrics: Metrics) -> str:
                 f'lwc_fleet_disruptions_total{{kind="{kind}"}} {value}'
             )
 
+    memguard = metrics.provider_section("memguard")
+    if isinstance(memguard, dict):
+        lines += prom_family(
+            "lwc_memguard_rss_bytes",
+            "gauge",
+            "Process RSS as last sampled by the memory governor.",
+        )
+        if "rss_bytes" in memguard:
+            lines.append(f"lwc_memguard_rss_bytes {memguard['rss_bytes']}")
+        lines += prom_family(
+            "lwc_memguard_level",
+            "gauge",
+            "Memory pressure level (0 ok, 1 soft, 2 hard).",
+        )
+        level_num = {"ok": 0, "soft": 1, "hard": 2}.get(
+            memguard.get("level"), 0
+        )
+        lines.append(f"lwc_memguard_level {level_num}")
+        lines += prom_family(
+            "lwc_memguard_trips",
+            "counter",
+            "Watermark crossings by kind (soft/hard/recovery).",
+        )
+        for kind, key in (
+            ("soft", "soft_trips"),
+            ("hard", "hard_trips"),
+            ("recovery", "recoveries"),
+        ):
+            lines.append(
+                f'lwc_memguard_trips_total{{kind="{kind}"}} '
+                f"{memguard.get(key, 0)}"
+            )
+
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -393,13 +430,18 @@ def register_resilience(metrics: Metrics, policy, fault_plan=None) -> None:
 
 
 def register_overload(
-    metrics: Metrics, admission=None, watchdog=None, lifecycle=None
+    metrics: Metrics,
+    admission=None,
+    watchdog=None,
+    lifecycle=None,
+    memguard=None,
 ) -> None:
     """Surface the overload/lifecycle subsystem on ``GET /metrics``:
     the ``admission`` section (inflight gauge, adaptive limit, per-reason
     shed counters), ``device_watchdog`` (health, active dispatches,
-    trip/recovery counters), and ``lifecycle`` (state, drain outcome,
-    cache flushes).  The batcher's own queue-depth gauge and shed
+    trip/recovery counters), ``lifecycle`` (state, drain outcome,
+    cache flushes), and ``memguard`` (RSS, pressure level, watermark
+    trip counters).  The batcher's own queue-depth gauge and shed
     counters ride its existing ``device_batcher`` provider."""
     if admission is not None:
         metrics.register_provider("admission", admission.snapshot)
@@ -407,6 +449,8 @@ def register_overload(
         metrics.register_provider("device_watchdog", watchdog.snapshot)
     if lifecycle is not None:
         metrics.register_provider("lifecycle", lifecycle.snapshot)
+    if memguard is not None:
+        metrics.register_provider("memguard", memguard.snapshot)
 
 
 def register_performance(metrics: Metrics, roofline=None) -> None:

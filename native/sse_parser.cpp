@@ -12,13 +12,24 @@
 // (joined by '\n'), a blank line dispatches, ':' comments and other fields
 // are ignored, LF and CRLF both accepted.
 //
+// Byte budgets (ISSUE 19 ingest plane): sse_parser_set_caps installs a
+// max-buffered-bytes cap on the newline-less residue and a max-event-bytes
+// cap on one event's accumulated data payload.  A trip drops the oversized
+// state (residue / open event), stops parsing at the offending line, and
+// is reported through sse_parser_take_trip — the ctypes wrapper raises the
+// typed IngestCapError.  Trip boundaries are byte-identical to the Python
+// parser (the parity contract tests/test_native.py enforces).
+//
 // C ABI:
 //   sse_parser_new()                       -> opaque handle
+//   sse_parser_set_caps(h, max_buf, max_ev)
 //   sse_parser_feed(h, buf, len)           -> number of completed events
 //   sse_parser_next_event(h, &len)         -> pointer to next event bytes
 //                                             (UTF-8, valid until the next
 //                                             feed/flush/free call)
 //   sse_parser_flush(h)                    -> trailing unterminated event
+//   sse_parser_take_trip(h, &observed)     -> 0 none / 1 buffer / 2 event;
+//                                             clears the pending trip
 //   sse_parser_free(h)
 
 #include <cstddef>
@@ -30,14 +41,25 @@
 
 namespace {
 
+constexpr int kTripNone = 0;
+constexpr int kTripBuffer = 1;
+constexpr int kTripEvent = 2;
+
 struct Parser {
   std::string buffer;        // undecoded bytes
   std::string data;          // accumulated data lines for the open event
   bool has_data = false;
   std::deque<std::string> events;  // completed, not yet consumed
   std::string scratch;       // storage for the last returned event
+  size_t max_buffer = 0;     // 0 = uncapped
+  size_t max_event = 0;      // 0 = uncapped
+  int trip_kind = kTripNone;
+  size_t trip_observed = 0;
 
-  void feed_line(const char* line, size_t len) {
+  // Returns true when this line tripped the event byte budget (the
+  // caller stops parsing at the offending line, like the Python
+  // generator raising mid-loop).
+  bool feed_line(const char* line, size_t len) {
     // strip trailing CR (CRLF endings)
     if (len > 0 && line[len - 1] == '\r') --len;
     if (len == 0) {  // blank line: dispatch
@@ -46,35 +68,56 @@ struct Parser {
         data.clear();
         has_data = false;
       }
-      return;
+      return false;
     }
-    if (line[0] == ':') return;  // comment
+    if (line[0] == ':') return false;  // comment
     const char* colon = static_cast<const char*>(memchr(line, ':', len));
     size_t field_len = colon ? static_cast<size_t>(colon - line) : len;
-    if (field_len != 4 || memcmp(line, "data", 4) != 0) return;
+    if (field_len != 4 || memcmp(line, "data", 4) != 0) return false;
     const char* value = colon ? colon + 1 : line + len;
     size_t value_len = colon ? len - field_len - 1 : 0;
     if (value_len > 0 && value[0] == ' ') {
       ++value;
       --value_len;
     }
+    size_t grown = data.size() + value_len + (has_data ? 1 : 0);
+    if (max_event != 0 && grown > max_event) {
+      // drop the oversized open event; the offending line is already
+      // consumed, so parsing can resume cleanly after the trip
+      data.clear();
+      has_data = false;
+      trip_kind = kTripEvent;
+      trip_observed = grown;
+      return true;
+    }
     if (has_data) data.push_back('\n');
     data.append(value, value_len);
     has_data = true;
+    return false;
   }
 
   size_t feed(const char* bytes, size_t len) {
     buffer.append(bytes, len);
     size_t start = 0;
+    bool tripped = false;
     for (;;) {
       const char* nl = static_cast<const char*>(
           memchr(buffer.data() + start, '\n', buffer.size() - start));
       if (!nl) break;
       size_t line_end = static_cast<size_t>(nl - buffer.data());
-      feed_line(buffer.data() + start, line_end - start);
+      tripped = feed_line(buffer.data() + start, line_end - start);
       start = line_end + 1;
+      if (tripped) break;  // stop at the offending line (Python parity)
     }
     if (start > 0) buffer.erase(0, start);
+    // the residue cap only applies once no complete line remains — the
+    // same boundary as the Python parser's `find == -1` branch — and an
+    // event trip short-circuits it (the Python generator already raised)
+    if (!tripped && max_buffer != 0 && buffer.size() > max_buffer) {
+      trip_kind = kTripBuffer;
+      trip_observed = buffer.size();
+      buffer.clear();
+    }
     return events.size();
   }
 
@@ -100,6 +143,13 @@ extern "C" {
 void* sse_parser_new() { return new Parser(); }
 
 void sse_parser_free(void* handle) { delete static_cast<Parser*>(handle); }
+
+// Install byte budgets (0 disables the corresponding cap).
+void sse_parser_set_caps(void* handle, size_t max_buffer, size_t max_event) {
+  auto* p = static_cast<Parser*>(handle);
+  p->max_buffer = max_buffer;
+  p->max_event = max_event;
+}
 
 // Returns the number of completed events ready to consume.
 size_t sse_parser_feed(void* handle, const uint8_t* buf, size_t len) {
@@ -127,6 +177,17 @@ size_t sse_parser_flush(void* handle) {
   auto* p = static_cast<Parser*>(handle);
   p->flush();
   return p->events.size();
+}
+
+// Reports (and clears) a pending byte-budget trip: returns the trip kind
+// (0 none / 1 buffer / 2 event) and writes the observed byte count.
+int sse_parser_take_trip(void* handle, size_t* observed) {
+  auto* p = static_cast<Parser*>(handle);
+  int kind = p->trip_kind;
+  *observed = p->trip_observed;
+  p->trip_kind = kTripNone;
+  p->trip_observed = 0;
+  return kind;
 }
 
 }  // extern "C"

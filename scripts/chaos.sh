@@ -47,6 +47,18 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
 rc_partition=$?
 [ $rc -eq 0 ] && rc=$rc_partition
 
+# Memory pass (tests/test_hostile_ingest.py): the hostile-upstream
+# ingest drills — seeded giant-line/newline-less-flood matrix through
+# the gateway with bounded RSS, plus the MemGuard soft/hard/recovery
+# drills.  Runs in tier-1 too; named here so the chaos gate exercises
+# the memory-pressure degradation paths even when "$@" narrows the
+# marker-based passes above.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_hostile_ingest.py -q -k "drill or memguard" \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+rc_memory=$?
+[ $rc -eq 0 ] && rc=$rc_memory
+
 # Fleet drill (scripts/fleet_drill.sh): three real replicas sharing a
 # FLEET_PEERS roster + one AOT_CACHE_DIR — a hot fingerprint hits
 # upstream exactly once fleet-wide, a cold replica joins with

@@ -44,6 +44,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_host_fastpat
 # regression).  Re-baseline with --write-budgets (DESIGN.md "Host fast
 # path").
 timeout -k 10 300 env JAX_PLATFORMS=cpu python bench_host.py --hostpath > /tmp/_t1_hostpath.json; rc_hp=$?; [ $rc -eq 0 ] && rc=$rc_hp; \
+# hostile-ingest + memory-governor tests, explicitly: the byte-budget
+# plane (parser cap trips against the committed corpus, the four
+# hostile fault kinds, cap x breaker/hedge/quorum composition, the
+# seeded J=8 x N=64 bounded-RSS gateway drill) and the MemGuard drills
+# (soft shrink, hard 503 shed_reason=memory, hysteretic recovery,
+# degraded_mem on /readyz) must fail tier-1 by name even if collection
+# of the glob above breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_hostile_ingest.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_hi=$?; [ $rc -eq 0 ] && rc=$rc_hi; \
+# ingest-bounds perf gate: bench_host.py --ingest-bounds measures the
+# per-chunk cost of the SSE byte accounting (capped parser vs uncapped)
+# on a realistic judge stream and fails when the overhead exceeds 2% of
+# the host-path per-chunk p50 — the budget plane must stay effectively
+# free on the hot loop.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python bench_host.py --ingest-bounds > /tmp/_t1_ingest.json; rc_ib=$?; [ $rc -eq 0 ] && rc=$rc_ib; \
 # analysis gate, explicitly: tests/test_analysis.py runs the same checker
 # under pytest, but naming the CLI here means a lint finding, a jaxpr
 # serving-path regression, or a mesh-audit failure (sharding coverage /

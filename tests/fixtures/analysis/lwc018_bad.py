@@ -1,0 +1,28 @@
+"""LWC018 violating fixture: unbounded growable containers on ingest paths.
+
+Four findings: two capless deques, a bytes buffer grown in an async-for
+with no len() check, and raw byte_stream chunks drained into a list.
+"""
+
+import collections
+from collections import deque
+
+
+def capless_queues():
+    orphans = deque()  # LWC018: no maxlen
+    backlog = collections.deque()  # LWC018: no maxlen
+    return orphans, backlog
+
+
+async def flood_reader(resp):
+    buf = bytearray()
+    async for chunk in resp.byte_stream():
+        buf += chunk  # LWC018: no len(buf) cap check in the loop
+    return bytes(buf)
+
+
+async def whole_stream_in_memory(resp):
+    chunks = []
+    async for chunk in resp.byte_stream():
+        chunks.append(chunk)  # LWC018: raw chunks, no len(chunks) check
+    return chunks

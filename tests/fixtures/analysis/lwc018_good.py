@@ -1,0 +1,46 @@
+"""LWC018 conforming fixture: every growable container states its bound.
+
+The conforming idioms are the repo's own: deques carry maxlen, byte
+buffers check len() against a budget inside the read loop (raising a
+typed cap error like clients/sse.py), and whole-stream drains cap the
+collected set before growing it.
+"""
+
+from collections import deque
+
+MAX_BYTES = 1 << 20
+MAX_CHUNKS = 4096
+
+
+class CapTrip(Exception):
+    pass
+
+
+def bounded_queue():
+    return deque(maxlen=4096)
+
+
+async def bounded_reader(resp):
+    buf = bytearray()
+    async for chunk in resp.byte_stream():
+        if len(buf) + len(chunk) > MAX_BYTES:
+            raise CapTrip(len(buf))
+        buf += chunk
+    return bytes(buf)
+
+
+async def bounded_collect(resp):
+    chunks = []
+    async for chunk in resp.byte_stream():
+        if len(chunks) >= MAX_CHUNKS:
+            break
+        chunks.append(chunk)
+    return chunks
+
+
+def grown_outside_a_loop(header, payload):
+    # growth outside any loop is caller-bounded, not upstream-bounded
+    frame = bytearray()
+    frame += header
+    frame.extend(payload)
+    return bytes(frame)

@@ -58,6 +58,8 @@ EXPECTED_BAD = {
     "LWC016": 5,  # await + wait_device_ready + upstream HTTP +
     # cross-condition wait + call-mediated blocking, all under a held lock
     "LWC017": 2,  # to_json_obj + jsonutil.dumps per merged chunk
+    "LWC018": 4,  # 2 capless deques + unguarded bytes growth +
+    # raw byte_stream chunks drained into a list
 }
 
 

@@ -126,3 +126,51 @@ def test_eviction_keeps_ballot_tables_consistent_under_churn():
     assert live == ["s28", "s29", "s30", "s31"]
     assert sorted(store._ballots) == sorted(live)
     assert sorted(store._score_requests) == sorted(live)
+
+
+def test_orphan_queue_structure_is_bounded():
+    # the deque itself is capped, not just the orphan COUNT: a workload
+    # whose completions all get archived leaves zero orphans but used to
+    # grow the queue with one stale entry per completion forever
+    store = InMemoryArchive()
+    assert store._ballot_orphans.maxlen == 2 * store.MAX_BALLOT_COMPLETIONS
+    n = store._ballot_orphans.maxlen + 500
+    for i in range(n):
+        cid = f"s{i}"
+        store.put_ballot(cid, 0, [("k", i)])
+        store.put_score(comp(cid))  # archived: entry goes stale in-queue
+    assert len(store._ballot_orphans) <= store._ballot_orphans.maxlen
+    assert store._n_orphan_ballots == 0
+    # every displacement was counted
+    assert store._orphan_queue_drops == 500
+    # archived ballots all survive — displacement only drops stale entries
+    assert len(store._ballots) == n
+
+
+def test_orphan_queue_displacement_evicts_live_head():
+    # when the displaced head is still a live orphan its ballots go with
+    # it — nothing unreachable by the eviction queue may keep its bytes
+    store = InMemoryArchive()
+    store.MAX_BALLOT_COMPLETIONS = 4  # shrink the cap; maxlen follows
+    from collections import deque
+
+    store._ballot_orphans = deque(maxlen=2 * store.MAX_BALLOT_COMPLETIONS)
+    # cap live orphans at the head...
+    for i in range(store.MAX_BALLOT_COMPLETIONS):
+        store.put_ballot(f"o{i}", 0, [("k", i)])
+    # ...then fill the tail with archived-then-balloted cids: queued but
+    # never counted as orphans, so the count-cap loop never fires
+    for i in range(store.MAX_BALLOT_COMPLETIONS):
+        store.put_score(comp(f"a{i}"))
+        store.put_ballot(f"a{i}", 0, [("k", i)])
+    assert len(store._ballot_orphans) == store._ballot_orphans.maxlen
+    assert store._ballot_orphans[0] == "o0"  # live orphan at the head
+    before = set(store._ballots)
+    store.put_ballot("one-more", 0, [("k", 99)])
+    assert store._orphan_queue_drops >= 1
+    assert len(store._ballot_orphans) <= store._ballot_orphans.maxlen
+    # accounting stays exact: count matches the actual orphan population
+    orphans = [c for c in store._ballots if c not in store._score]
+    assert store._n_orphan_ballots == len(orphans)
+    assert "one-more" in store._ballots
+    assert before - set(store._ballots)  # something was really evicted

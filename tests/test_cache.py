@@ -589,3 +589,44 @@ def test_gateway_authorization_partitions_the_cache():
 
     go(with_client(app, run))
     assert len(transport.requests) == 2  # no cross-credential hits
+
+
+def test_ingest_cap_degraded_stream_never_cached():
+    # ISSUE 19 admission guard: a consensus degraded by a judge leg's
+    # ingest byte-budget trip (per-judge `ingest_cap` error entry +
+    # `degraded: true` on the final frame) must never poison the cache —
+    # same contract as quorum/deadline degradation
+    recorded = []
+
+    async def cap_tripped():
+        yield make_chunk("a")
+        yield ChatCompletionChunk.from_json_obj(
+            {
+                "id": "r",
+                "created": 1,
+                "model": "m",
+                "degraded": True,
+                "choices": [
+                    {"index": 0, "delta": {}, "finish_reason": "stop"},
+                    {
+                        "index": 3,
+                        "delta": {},
+                        "finish_reason": None,
+                        "error": {
+                            "code": 502,
+                            "message": {
+                                "kind": "ingest_cap",
+                                "message": "sse_event exceeded 4096 bytes",
+                            },
+                        },
+                    },
+                ],
+            }
+        )
+
+    async def run():
+        async for _ in record_stream(cap_tripped(), recorded.append):
+            pass
+
+    go(run())
+    assert recorded == []

@@ -194,7 +194,9 @@ def test_fixed_kind_order_is_part_of_the_contract():
     # KINDS order feeds the cumulative-probability walk; a reorder would
     # silently reshuffle every seeded plan's fault sequence
     assert KINDS == (
-        "connect", "5xx", "stall_first", "stall_mid", "malformed", "truncate"
+        "connect", "5xx", "stall_first", "stall_mid", "malformed", "truncate",
+        "giant_line", "newline_less_flood", "oversized_unary",
+        "binary_garbage",
     )
 
 

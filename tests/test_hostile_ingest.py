@@ -1,0 +1,961 @@
+"""Hostile-upstream ingest hardening (ISSUE 19): the byte-budget plane
+and the host memory governor, drilled end to end.
+
+Three layers, seeded and deterministic throughout:
+
+* the SSE parser byte budgets against the committed corpus under
+  ``tests/fixtures/ingest/`` (trip boundaries, usable-after-trip);
+* the four hostile fault kinds (``giant_line``, ``newline_less_flood``,
+  ``oversized_unary``, ``binary_garbage``) through the real client
+  stack, including how cap trips compose with the breaker, hedging and
+  quorum (a capped leg degrades like a 499 — excluded, never fatal);
+* the J=8 x N=64 gateway drill: a seeded hostile fault matrix against
+  ``POST /score/completions`` asserting zero crashes, degraded final
+  frames with per-judge cap-trip entries, and bounded RSS while the
+  offered hostile payload is orders of magnitude larger;
+* the MemGuard drills named by the acceptance criteria: soft pressure
+  shrinks budgets, hard pressure sheds ``503 shed_reason=memory``,
+  recovery is hysteretic, and /readyz carries ``degraded_mem``.
+"""
+
+import asyncio
+import json
+import pathlib
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from llm_weighted_consensus_tpu import archive, registry
+from llm_weighted_consensus_tpu.ballot import PrefixTree, branch_limit
+from llm_weighted_consensus_tpu.cache import ScoreCache
+from llm_weighted_consensus_tpu.clients import sse
+from llm_weighted_consensus_tpu.clients.chat import (
+    ApiBase,
+    BackoffPolicy,
+    DefaultChatClient,
+)
+from llm_weighted_consensus_tpu.clients.score import ScoreClient
+from llm_weighted_consensus_tpu.errors import (
+    BreakerOpenError,
+    IngestCapError,
+)
+from llm_weighted_consensus_tpu.identity.model import ModelBase
+from llm_weighted_consensus_tpu.resilience import (
+    BreakerConfig,
+    BreakerRegistry,
+    FaultInjectionTransport,
+    FaultPlan,
+    HedgePolicy,
+    ResiliencePolicy,
+)
+from llm_weighted_consensus_tpu.resilience import memguard as memguard_mod
+from llm_weighted_consensus_tpu.resilience.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    shed_response,
+)
+from llm_weighted_consensus_tpu.resilience.memguard import (
+    LEVEL_HARD,
+    LEVEL_OK,
+    LEVEL_SOFT,
+    MemGuard,
+    read_rss_bytes,
+    resolve_watermarks,
+)
+from llm_weighted_consensus_tpu.serve import build_app
+from llm_weighted_consensus_tpu.serve.config import Config
+from llm_weighted_consensus_tpu.serve.lifecycle import (
+    Lifecycle,
+    health_handlers,
+)
+from llm_weighted_consensus_tpu.serve.metrics import (
+    Metrics,
+    register_overload,
+    render_prometheus,
+)
+from llm_weighted_consensus_tpu.types.chat_request import (
+    ChatCompletionCreateParams,
+    UserMessage,
+)
+from llm_weighted_consensus_tpu.types.score_request import (
+    ChatCompletionCreateParams as ScoreParams,
+)
+from llm_weighted_consensus_tpu.utils import jsonutil
+
+from fakes import FakeTransport, Script, chunk_obj
+
+SEED = 19
+NO_RETRY = BackoffPolicy(max_elapsed_ms=0)
+AB1 = [ApiBase("https://a.example", "key-a")]
+AB = [
+    ApiBase("https://a.example", "key-a"),
+    ApiBase("https://b.example", "key-b"),
+]
+
+CORPUS = pathlib.Path(__file__).parent / "fixtures" / "ingest"
+
+TEXTS = ["answer alpha", "answer beta", "answer gamma"]
+
+
+def go(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def corpus_bytes(name):
+    return (CORPUS / name).read_bytes()
+
+
+def chat_params():
+    return ChatCompletionCreateParams(
+        messages=[UserMessage(content="hi")], model="fake-model"
+    )
+
+
+def healthy_script():
+    return Script([chunk_obj("a"), chunk_obj("b", finish="stop")])
+
+
+def ballot_keys(n):
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, n, branch_limit(None))
+    return {idx: key for key, idx in tree.key_indices(rng)}
+
+
+def judge_script(key, **kw):
+    return Script(
+        [
+            chunk_obj("I pick ", model="up-model"),
+            chunk_obj(f"{key} as best.", model="up-model", finish="stop"),
+        ],
+        **kw,
+    )
+
+
+def score_params(model_json):
+    return ScoreParams.from_json_obj(
+        {
+            "messages": [{"role": "user", "content": "pick the best"}],
+            "model": model_json,
+            "choices": TEXTS,
+        }
+    )
+
+
+def inline_model(judges):
+    model = ModelBase.from_json_obj({"llms": judges}).into_model_validate()
+    return model, {"llms": [llm.base.to_json_obj() for llm in model.llms]}
+
+
+async def _stream_items(c, p=None):
+    stream = await c.create_streaming(None, p or chat_params())
+    return [item async for item in stream]
+
+
+def is_cap_error_obj(error) -> bool:
+    """Walk a per-judge ResponseError (attr form) or a JSON error entry
+    (dict form) for the nested ``kind == "ingest_cap"`` marker — the
+    same walk clients/score.py does when flagging a frame degraded."""
+    msg = error.get("message") if isinstance(error, dict) else getattr(
+        error, "message", None
+    )
+    while isinstance(msg, dict):
+        if msg.get("kind") == "ingest_cap":
+            return True
+        msg = msg.get("error")
+    return False
+
+
+# -- corpus x SSEParser byte budgets ------------------------------------------
+
+
+def parse_all(raw, **caps):
+    parser = sse.SSEParser(**caps)
+    events = list(parser.feed(raw))
+    tail = parser.flush()
+    if tail is not None:
+        events.append(tail)
+    return events
+
+
+def feed_until_trip(parser, raw):
+    events = []
+    try:
+        for event in parser.feed(raw):
+            events.append(event)
+    except IngestCapError as e:
+        return events, e
+    return events, None
+
+
+def test_corpus_giant_line_trips_event_cap_after_completed_events():
+    raw = corpus_bytes("giant_line.sse")
+    ref = parse_all(raw)  # uncapped reference: e1, e2, giant, e3
+    assert len(ref) == 4 and len(ref[2]) >= 8192
+
+    parser = sse.SSEParser(max_event_bytes=4096)
+    events, trip = feed_until_trip(parser, raw)
+    # events completed before the offending line still surface
+    assert events == ref[:2]
+    assert trip is not None and trip.what == "sse_event"
+    assert trip.observed_bytes >= 8192
+    assert parser.cap_trips == 1
+    # the oversized open event was dropped, the rest of the buffered
+    # stream parses cleanly: the parser stays usable after a trip
+    more, trip2 = feed_until_trip(parser, b"")
+    assert trip2 is None
+    assert more == [ref[3]]
+
+
+def test_corpus_newline_less_flood_trips_buffer_cap():
+    raw = corpus_bytes("newline_less_flood.bin")
+    parser = sse.SSEParser(max_buffer_bytes=4096)
+    events, trip = feed_until_trip(parser, raw)
+    assert events == []
+    assert trip is not None and trip.what == "sse_buffer"
+    assert trip.observed_bytes == len(raw)
+    # residue dropped: bounded AND usable for subsequent feeds
+    assert len(parser._buffer) == 0
+    assert list(parser.feed(b"data: ok\n\n")) == ["ok"]
+
+
+def test_corpus_binary_garbage_is_bounded_and_survivable():
+    raw = corpus_bytes("binary_garbage.bin")
+    parser = sse.SSEParser(max_buffer_bytes=4096, max_event_bytes=4096)
+    events, trip = feed_until_trip(parser, raw)
+    # 4 KiB of seeded garbage never exceeds a 4 KiB budget (trips are
+    # strictly-greater); whatever junk lines it forms are not data:
+    # fields, so nothing hostile surfaces as an event either
+    assert trip is None
+    assert parser.cap_trips == 0
+    assert len(parser._buffer) <= 4096
+    # a real stream resumes after the garbage residue flushes through
+    list(parser.feed(b"\n"))
+    assert list(parser.feed(b"data: ok\n\n"))[-1] == "ok"
+
+
+def test_corpus_interleaved_parser_usable_after_trip():
+    raw = corpus_bytes("interleaved.sse")
+    ref = parse_all(raw)
+    giant = next(i for i, e in enumerate(ref) if len(e) >= 8192)
+
+    parser = sse.SSEParser(max_event_bytes=4096)
+    events, trip = feed_until_trip(parser, raw)
+    assert events == ref[:giant]
+    assert trip is not None and trip.what == "sse_event"
+    more, trip2 = feed_until_trip(parser, b"")
+    assert trip2 is None
+    # everything after the giant line — including [DONE] — still parses
+    assert more == ref[giant + 1 :]
+    assert more[-1] == "[DONE]"
+
+
+def test_make_parser_caps_flow_through():
+    p = sse.make_parser(max_buffer_bytes=5, max_event_bytes=7)
+    assert p.max_buffer_bytes == 5
+    assert p.max_event_bytes == 7
+
+
+# -- hostile fault kinds through the chat client ------------------------------
+
+
+def hostile_client(faults, *, flood_bytes, n_scripts=1, **kw):
+    plan = FaultPlan(script=list(faults), flood_bytes=flood_bytes)
+    transport = FakeTransport([healthy_script() for _ in range(n_scripts)])
+    kw.setdefault("backoff", NO_RETRY)
+    client = DefaultChatClient(
+        FaultInjectionTransport(transport, plan), AB1, **kw
+    )
+    return client, transport, plan
+
+
+def test_giant_line_fault_trips_event_cap_mid_stream():
+    client, _, _ = hostile_client(
+        ["giant_line"],
+        flood_bytes=8192,
+        sse_max_event_bytes=4096,
+        judge_stream_max_bytes=1 << 20,
+    )
+    items = go(_stream_items(client))
+    assert items[0].choices[0].delta.content == "a"  # stream committed
+    assert isinstance(items[-1], IngestCapError)
+    assert items[-1].what == "sse_event"
+
+
+def test_newline_less_flood_fault_trips_stream_budget():
+    # the cumulative per-judge budget is checked before the parser sees
+    # the bytes: a flood bigger than the leg budget trips judge_stream
+    client, _, _ = hostile_client(
+        ["newline_less_flood"],
+        flood_bytes=8192,
+        judge_stream_max_bytes=4096,
+    )
+    items = go(_stream_items(client))
+    assert isinstance(items[-1], IngestCapError)
+    assert items[-1].what == "judge_stream"
+
+
+def test_newline_less_flood_fault_trips_residue_cap():
+    # with the leg budget generous, the same flood accumulates as
+    # newline-less parser residue and trips sse_buffer instead
+    client, _, _ = hostile_client(
+        ["newline_less_flood"],
+        flood_bytes=8192,
+        sse_max_event_bytes=4096,
+        judge_stream_max_bytes=1 << 20,
+    )
+    items = go(_stream_items(client))
+    assert isinstance(items[-1], IngestCapError)
+    assert items[-1].what == "sse_buffer"
+
+
+def test_oversized_unary_fault_trips_body_cap():
+    client, _, _ = hostile_client(
+        ["oversized_unary"],
+        flood_bytes=8192,
+        judge_stream_max_bytes=4096,
+    )
+    with pytest.raises(IngestCapError) as ei:
+        go(_stream_items(client))
+    assert ei.value.what == "unary_body"
+    assert ei.value.observed_bytes == 8192
+
+
+def test_oversized_unary_corpus_body_trips_without_fault_plan():
+    # same trip straight off the wire: a non-2xx whose body is the
+    # committed 8 KiB blob, no injector involved
+    transport = FakeTransport(
+        [Script(status=503, body=corpus_bytes("oversized_unary.bin"))]
+    )
+    client = DefaultChatClient(
+        transport, AB1, backoff=NO_RETRY, judge_stream_max_bytes=4096
+    )
+    with pytest.raises(IngestCapError) as ei:
+        go(_stream_items(client))
+    assert ei.value.what == "unary_body"
+
+
+def test_binary_garbage_fault_stream_survives():
+    def run_once():
+        client, _, _ = hostile_client(
+            ["binary_garbage"], flood_bytes=8192
+        )
+        return go(_stream_items(client))
+
+    items = run_once()
+    assert items[0].choices[0].delta.content == "a"
+    # garbage decodes to junk (ignored lines / replacement chars), never
+    # a cap trip at the serving budgets, and never a crash: the stream
+    # reaches its terminator
+    assert not any(isinstance(i, IngestCapError) for i in items)
+    # seeded: a second run produces the identical item shape
+    again = run_once()
+    assert [type(i).__name__ for i in again] == [
+        type(i).__name__ for i in items
+    ]
+
+
+def test_fault_plan_parses_flood_bytes_key():
+    plan = FaultPlan.parse("seed=7,giant_line=0.5,flood_bytes=1024")
+    assert plan.flood_bytes == 1024
+    assert plan.probabilities["giant_line"] == 0.5
+
+
+def test_hostile_fault_matrix_is_deterministic():
+    probs = {
+        "giant_line": 0.25,
+        "newline_less_flood": 0.25,
+        "oversized_unary": 0.2,
+        "binary_garbage": 0.2,
+    }
+    def draw(seed):
+        plan = FaultPlan(seed=seed, probabilities=probs)
+        return [plan.next_fault() for _ in range(100)]
+
+    draws = [draw(9), draw(9)]
+    assert draws[0] == draws[1]
+    assert set(probs) <= set(filter(None, draws[0]))
+    assert draw(10) != draws[0]
+
+
+def test_mid_utf8_cuts_never_corrupt_events():
+    # byte-at-a-time feeding cuts every multi-byte character: the parser
+    # buffers raw bytes and decodes per completed line, so the event
+    # survives intact (and errors="replace" bounds the worst case)
+    raw = "data: voilà ✓ — ¡hostile! ✓\n\n".encode("utf-8")
+    parser = sse.SSEParser(max_buffer_bytes=4096, max_event_bytes=4096)
+    events = []
+    for i in range(len(raw)):
+        events.extend(parser.feed(raw[i : i + 1]))
+    assert events == ["voilà ✓ — ¡hostile! ✓"]
+    assert parser.cap_trips == 0
+
+
+def test_redos_shaped_ballot_content_scans_linearly():
+    import re
+    import time as time_mod
+
+    from llm_weighted_consensus_tpu.ballot.vote import extract_vote
+    from llm_weighted_consensus_tpu.errors import InvalidContentError
+
+    rng = random.Random(SEED)
+    tree = PrefixTree.build(rng, 64, branch_limit(None))
+    key_indices = tree.key_indices(rng)
+    keys = [k for k, _ in key_indices]
+    with_ticks, without_ticks = PrefixTree.regex_patterns(keys)
+    w = re.compile(with_ticks)
+    wo = re.compile(without_ticks)
+    # adversarial judge output: ~200 KiB of near-miss key prefixes (the
+    # shape that would explode a backtracking alternation); the patterns
+    # are pure literal alternations, so the scan must stay linear
+    near_miss = "`" + keys[0][1:-1][:-1] + "X` "
+    hostile = near_miss * (200_000 // len(near_miss))
+    t0 = time_mod.perf_counter()
+    try:
+        extract_vote(tree, w, wo, 64, hostile)
+    except InvalidContentError:
+        pass  # near-misses may legitimately match no key at all
+    elapsed = time_mod.perf_counter() - t0
+    assert elapsed < 1.0, f"ballot scan took {elapsed:.3f}s on 200KiB"
+    # a real backticked key after the hostile prefix still lands one-hot
+    idx = key_indices[0][1]
+    vote = extract_vote(tree, w, wo, 64, hostile + f"I pick {keys[0]}.")
+    assert vote[idx] == 1
+
+
+# -- cap trips x resilience machinery -----------------------------------------
+
+
+def test_cap_trips_count_against_the_breaker():
+    # a pre-commit trip (the oversized unary body) is an attempt-level
+    # failure, so it lands on the upstream's breaker exactly like a
+    # transport error; post-commit mid-stream trips ride the degraded
+    # final-frame path instead (the quorum test below)
+    t = {"now": 0.0}
+    policy = ResiliencePolicy(
+        breakers=BreakerRegistry(
+            BreakerConfig(
+                threshold=1.0, window=2, min_samples=2, cooldown_ms=5000
+            ),
+            clock=lambda: t["now"],
+        )
+    )
+    plan = FaultPlan(
+        script=["oversized_unary", "oversized_unary"], flood_bytes=8192
+    )
+    transport = FakeTransport([healthy_script()])
+    client = DefaultChatClient(
+        FaultInjectionTransport(transport, plan),
+        AB1,
+        backoff=NO_RETRY,
+        resilience=policy,
+        judge_stream_max_bytes=4096,
+    )
+    for _ in range(2):
+        with pytest.raises(IngestCapError):
+            go(_stream_items(client))
+    assert plan.requests == 2
+    # a flooding upstream is a failing upstream: the breaker opens and
+    # the third attempt is refused locally (the plan sees no request)
+    with pytest.raises(BreakerOpenError):
+        go(_stream_items(client))
+    assert plan.requests == 2
+    key = "https://a.example|fake-model"
+    assert policy.snapshot()["breakers"][key]["state"] == "open"
+    # cooldown -> half-open probe -> healthy slot -> closed again
+    t["now"] += 6.0
+    items = go(_stream_items(client))
+    assert items[0].choices[0].delta.content == "a"
+    assert policy.snapshot()["breakers"][key]["state"] == "closed"
+
+
+def make_hostile_score_client(scripts, plan, policy, api_bases=None, **kw):
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        FaultInjectionTransport(transport, plan),
+        api_bases or AB1,
+        backoff=NO_RETRY,
+        resilience=policy,
+        **kw,
+    )
+    client = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+        resilience=policy,
+    )
+    return client, transport
+
+
+async def collect(client, params):
+    stream = await client.create_streaming(None, params)
+    return [item async for item in stream]
+
+
+def test_capped_judge_is_excluded_like_a_499_and_frame_degrades():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy()
+    model, model_json = inline_model(
+        [
+            {"model": "judge-a", "weight": {"type": "static", "weight": 2}},
+            {"model": "judge-b", "weight": {"type": "static", "weight": 1}},
+            {"model": "judge-c", "weight": {"type": "static", "weight": 1}},
+        ]
+    )
+    # the plan is positional (one slot per upstream request, in fan-out
+    # order); flood a WEIGHT-1 judge so the surviving 2+1 decide alone
+    flood_pos = next(
+        i
+        for i, llm in enumerate(model.llms)
+        if llm.base.model in ("judge-b", "judge-c")
+    )
+    faults = [None] * len(model.llms)
+    faults[flood_pos] = "giant_line"
+    plan = FaultPlan(script=faults, flood_bytes=8192)
+    client, transport = make_hostile_score_client(
+        [judge_script(keys[1]) for _ in model.llms],
+        plan,
+        policy,
+        sse_max_event_bytes=4096,
+        judge_stream_max_bytes=1 << 20,
+    )
+    items = go(collect(client, score_params(model_json)))
+    final = items[-1]
+    # the two surviving judges settle the vote; the capped leg is an
+    # error entry, not a fatality
+    cand = {c.index: c for c in final.choices if c.index < 3}
+    assert cand[1].confidence is not None
+    tail_errors = [
+        c.error
+        for c in final.choices
+        if c.index >= 3 and c.error is not None
+    ]
+    assert len(tail_errors) == 1
+    assert is_cap_error_obj(tail_errors[0])
+    # marked degraded (never cacheable) and counted
+    assert getattr(final, "degraded", False) is True
+    assert policy.counters["ingest_cap_degraded"] == 1
+
+
+def test_flooding_slow_primary_loses_hedge_race_cleanly():
+    keys = ballot_keys(3)
+    policy = ResiliencePolicy(hedge=HedgePolicy(delay_ms=30.0))
+    _, model_json = inline_model(
+        [{"model": "judge-a", "weight": {"type": "static", "weight": 1}}]
+    )
+    # primary: slow first chunk AND a giant-line flood behind it; the
+    # hedged backup (next api base) wins long before the flood lands
+    plan = FaultPlan(script=["giant_line", None], flood_bytes=8192)
+    client, transport = make_hostile_score_client(
+        [judge_script(keys[1], delays={0: 1.0}), judge_script(keys[1])],
+        plan,
+        policy,
+        api_bases=AB,
+        sse_max_event_bytes=4096,
+    )
+    items = go(collect(client, score_params(model_json)))
+    assert len(transport.requests) == 2  # primary + one hedged backup
+    assert policy.counters["hedge_launched"] == 1
+    assert policy.counters["hedge_won"] == 1
+    final = items[-1]
+    # the losing primary's flood was discarded with the race: the final
+    # frame is clean, the vote tallied once
+    assert not getattr(final, "degraded", False)
+    assert not any(
+        c.error is not None and is_cap_error_obj(c.error)
+        for c in final.choices
+    )
+
+
+# -- the seeded J=8 x N=64 hostile gateway drill ------------------------------
+
+DRILL_JUDGES = 8
+DRILL_REQUESTS = 64
+
+
+def sse_events(text):
+    return [
+        block[len("data: ") :]
+        for block in text.split("\n\n")
+        if block.startswith("data: ")
+    ]
+
+
+def frame_cap_entries(frame):
+    """Per-judge cap-trip error entries in a final-frame JSON object."""
+    return [
+        c
+        for c in frame.get("choices", [])
+        if isinstance(c.get("error"), dict) and is_cap_error_obj(c["error"])
+    ]
+
+
+def test_hostile_ingest_drill_j8_n64_bounded_and_degraded():
+    keys = ballot_keys(3)
+    plan = FaultPlan(
+        seed=SEED,
+        probabilities={"giant_line": 0.35, "newline_less_flood": 0.35},
+        flood_bytes=1 << 20,
+    )
+    _, model_json = inline_model(
+        [
+            {"model": f"judge-{i}", "weight": {"type": "static", "weight": 1}}
+            for i in range(DRILL_JUDGES)
+        ]
+    )
+    scripts = [
+        judge_script(keys[1])
+        for _ in range(DRILL_JUDGES * DRILL_REQUESTS)
+    ]
+    transport = FakeTransport(scripts)
+    chat = DefaultChatClient(
+        FaultInjectionTransport(transport, plan),
+        AB1,
+        backoff=NO_RETRY,
+        # caps sized for trip diversity against the 1 MiB floods: a
+        # complete giant line trips the event cap, a newline-less flood
+        # trips the residue cap, both far under the leg stream budget
+        sse_max_event_bytes=64 * 1024,
+        judge_stream_max_bytes=16 * 1024 * 1024,
+    )
+    policy = ResiliencePolicy()
+    score = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+        resilience=policy,
+    )
+    app = build_app(chat, score)
+
+    body = {
+        "stream": True,
+        "messages": [{"role": "user", "content": "pick the best"}],
+        "model": model_json,
+        "choices": TEXTS,
+    }
+
+    async def run(client):
+        statuses, degraded, cap_entries, vote_failures = [], 0, 0, 0
+        for _ in range(DRILL_REQUESTS):
+            resp = await client.post(
+                "/score/completions",
+                data=jsonutil.dumps(body),
+                headers={"content-type": "application/json"},
+            )
+            statuses.append(resp.status)
+            events = sse_events(await resp.text())
+            assert events[-1] == "[DONE]"
+            frames = [json.loads(e) for e in events[:-1]]
+            final = frames[-1]
+            if "choices" not in final:
+                # every judge leg faulted: a valid all_votes_failed
+                # error envelope, which is degradation, not a crash
+                assert final["message"]["error"]["kind"] == "all_votes_failed"
+                vote_failures += 1
+                continue
+            entries = frame_cap_entries(final)
+            if entries:
+                cap_entries += len(entries)
+                # cap-tripped legs always mark the frame degraded
+                assert final.get("degraded") is True
+            if final.get("degraded"):
+                degraded += 1
+        return statuses, degraded, cap_entries, vote_failures
+
+    rss_before = read_rss_bytes()
+
+    async def drive():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await run(client)
+        finally:
+            await client.close()
+
+    statuses, degraded, cap_entries, vote_failures = go(drive())
+    rss_after = read_rss_bytes()
+
+    # zero crashes: every request answered the streaming protocol
+    assert statuses == [200] * DRILL_REQUESTS
+    # the matrix bit: both hostile kinds fired, every leg consumed
+    snap = plan.snapshot()
+    assert snap["requests"] == DRILL_JUDGES * DRILL_REQUESTS
+    assert snap["injected"]["giant_line"] >= 1
+    assert snap["injected"]["newline_less_flood"] >= 1
+    # degraded frames with per-judge cap-trip entries actually happened
+    assert degraded >= 1
+    assert cap_entries >= DRILL_JUDGES  # many legs tripped across the run
+    assert vote_failures < DRILL_REQUESTS  # plenty of requests settled
+    # bounded ingest: the injectors OFFERED hundreds of MiB of hostile
+    # bytes; the byte budgets stopped reading instead of buffering, so
+    # peak RSS growth stays a small constant
+    offered = sum(snap["injected"].values()) * plan.flood_bytes
+    assert offered > 100 * (1 << 20)
+    if rss_before is not None and rss_after is not None:
+        assert rss_after - rss_before < 256 * (1 << 20)
+    # cache can never hold a degraded result (regression vs replay.py)
+    assert policy.counters["ingest_cap_degraded"] == degraded
+
+
+# -- MemGuard drills (named by the acceptance criteria) -----------------------
+
+
+def fed(values):
+    """An rss_fn fed from a list (the DeviceWatchdog fake-clock idiom)."""
+    seq = iter(values)
+    return lambda: next(seq)
+
+
+def test_memguard_drill_soft_pressure_shrinks_budgets():
+    cache = ScoreCache(60, 1 << 20)
+    sink = SimpleNamespace(capacity=4096)
+    adm = AdmissionController(
+        AdmissionConfig(max_inflight=8, adaptive=True, min_limit=2)
+    )
+    mg = MemGuard(1000, 2000, rss_fn=fed([500, 1500]))
+    mg.govern(caches=[cache], sinks=[sink], admission=adm)
+
+    assert mg.check() == LEVEL_OK
+    assert cache.max_bytes == 1 << 20  # untouched below the watermark
+
+    assert mg.check() == LEVEL_SOFT
+    assert cache.max_bytes == (1 << 20) // 2
+    assert sink.capacity == 2048
+    assert adm.limit == 4.0  # AIMD limit decayed once
+    snap = mg.snapshot()
+    assert snap["level"] == "soft"
+    assert snap["soft_trips"] == 1
+    assert mg.degraded is True
+    assert mg.gate() is None  # soft pressure still admits everything
+
+
+def test_memguard_drill_hard_pressure_sheds_503_shed_reason_memory():
+    mg = MemGuard(1000, 2000, rss_fn=fed([2500]))
+    assert mg.check() == LEVEL_HARD
+    assert mg.gate() == "memory"
+    assert mg.shedding is True
+
+    adm = AdmissionController(AdmissionConfig(), mem_gate=mg.gate)
+    # ALL new work sheds under hard pressure, device-bound or not
+    assert adm.try_acquire() == "memory"
+    assert adm.try_acquire(device_work=True) == "memory"
+    assert adm.shed == {"memory": 2}
+
+    resp = shed_response("memory", 1000.0)
+    assert resp.status == 503
+    assert resp.headers["Retry-After"] == "1"
+    envelope = json.loads(resp.text)
+    assert envelope["code"] == 503
+    assert envelope["message"]["kind"] == "overloaded"
+    assert envelope["message"]["shed_reason"] == "memory"
+
+
+def test_memguard_drill_hysteretic_recovery():
+    cache = SimpleNamespace(max_bytes=1000)
+    # soft=1000 hard=2000, recover_fraction=0.9: recovery needs RSS
+    # strictly below 900 / 1800 — hovering at the boundary never flaps
+    mg = MemGuard(
+        1000,
+        2000,
+        rss_fn=fed([1500, 950, 899, 2500, 1850, 1750, 100]),
+        recover_fraction=0.9,
+    )
+    mg.govern(caches=[cache])
+
+    assert mg.check() == LEVEL_SOFT  # 1500: tripped
+    assert cache.max_bytes == 500
+    assert mg.check() == LEVEL_SOFT  # 950: below soft, above 0.9*soft
+    assert cache.max_bytes == 500  # ...so still shrunk
+    assert mg.check() == LEVEL_OK  # 899: truly recovered
+    assert cache.max_bytes == 1000  # budget restored
+    assert mg.snapshot()["recoveries"] == 1
+
+    assert mg.check() == LEVEL_HARD  # 2500: straight to shedding
+    assert mg.gate() == "memory"
+    assert mg.check() == LEVEL_HARD  # 1850: above 0.9*hard, still sheds
+    assert mg.check() == LEVEL_SOFT  # 1750: admits again, still degraded
+    assert mg.gate() is None
+    assert mg.check() == LEVEL_OK  # 100: fully recovered
+    snap = mg.snapshot()
+    assert snap["soft_trips"] == 2
+    assert snap["hard_trips"] == 1
+    assert snap["recoveries"] == 2
+
+
+def test_memguard_drill_degraded_mem_on_readyz():
+    rss = {"v": 500}
+    mg = MemGuard(1000, 2000, rss_fn=lambda: rss["v"])
+    lifecycle = Lifecycle(memguard=mg)
+    _livez, readyz = health_handlers(lifecycle)
+
+    async def body():
+        return json.loads((await readyz(None)).body)
+
+    assert go(body()) == {"ready": True}
+    rss["v"] = 1500
+    mg.check()
+    out = go(body())
+    # still 200/ready: in-flight work is finishing, probes keep passing
+    assert out["ready"] is True
+    assert out["degraded_mem"] is True
+    assert out["mem_level"] == "soft"
+    rss["v"] = 100
+    mg.check()
+    assert go(body()) == {"ready": True}
+
+
+def test_memguard_watermark_resolution(monkeypatch):
+    # explicit pair passes through (hard clamped >= soft)
+    assert resolve_watermarks(100, 200) == (100, 200)
+    assert resolve_watermarks(300, 200) == (300, 300)
+    # auto = 80% / 90% of MemTotal
+    monkeypatch.setattr(
+        memguard_mod, "read_mem_total_bytes", lambda: 1000
+    )
+    assert resolve_watermarks(0, 0) == (800, 900)
+    assert resolve_watermarks(0, 950) == (800, 950)
+    # unreadable MemTotal: disabled, never guessed
+    monkeypatch.setattr(
+        memguard_mod, "read_mem_total_bytes", lambda: None
+    )
+    assert resolve_watermarks(0, 0) is None
+
+
+def test_config_memguard_factory():
+    assert Config(memguard_enabled=False).memguard() is None
+    mg = Config(mem_soft_bytes=100, mem_hard_bytes=200).memguard()
+    assert isinstance(mg, MemGuard)
+    assert (mg.soft_bytes, mg.hard_bytes) == (100, 200)
+
+
+def test_memguard_rss_source_reads_this_process():
+    rss = read_rss_bytes()
+    assert rss is not None and rss > 0
+
+
+def test_memguard_rides_the_metrics_plane():
+    metrics = Metrics()
+    mg = MemGuard(1000, 2000, rss_fn=fed([1500]))
+    register_overload(metrics, memguard=mg)
+    mg.check()
+    section = metrics.provider_section("memguard")
+    assert section["level"] == "soft"
+    assert section["rss_bytes"] == 1500
+    text = render_prometheus(metrics)
+    assert "lwc_memguard_rss_bytes 1500" in text
+    assert "lwc_memguard_level" in text
+    assert "lwc_memguard_trips" in text
+
+
+# -- gateway body caps (client_max_size + 413 envelope) -----------------------
+
+
+def make_capped_app(max_body_bytes, fleet=None):
+    transport = FakeTransport([])
+    chat = DefaultChatClient(
+        transport, [ApiBase("https://up.example", "k")], backoff=NO_RETRY
+    )
+    score = ScoreClient(
+        chat,
+        registry.InMemoryModelRegistry(),
+        archive_fetcher=archive.InMemoryArchive(),
+        rng_factory=lambda: random.Random(SEED),
+    )
+    return build_app(
+        chat, score, fleet=fleet, max_body_bytes=max_body_bytes
+    )
+
+
+async def with_client(app, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_gateway_oversized_body_413_payload_too_large_envelope():
+    app = make_capped_app(1024)
+
+    async def run(client):
+        resp = await client.post(
+            "/score/completions",
+            data=b"x" * 4096,
+            headers={"content-type": "application/json"},
+        )
+        assert resp.status == 413
+        envelope = await resp.json()
+        assert envelope["code"] == 413
+        assert envelope["message"]["kind"] == "payload_too_large"
+        # a right-sized (if malformed) body still reaches the handler
+        resp = await client.post(
+            "/score/completions",
+            data=b"{}",
+            headers={"content-type": "application/json"},
+        )
+        assert resp.status == 400
+
+    go(with_client(app, run))
+
+
+def test_huge_n_body_trips_the_default_cap_with_envelope():
+    # MAX_BODY_BYTES=0 keeps aiohttp's own 1 MiB client_max_size rather
+    # than lifting the cap — a huge-N request body (thousands of
+    # candidates) still gets the structured 413 envelope, never an
+    # unbounded read or a stock HTML error page
+    app = make_capped_app(0)
+
+    async def run(client):
+        huge_n = jsonutil.dumps(
+            {
+                "model": "fake-model",
+                "messages": [{"role": "user", "content": "q"}],
+                "choices": [{"text": "c" * 512} for _ in range(4096)],
+            }
+        ).encode()
+        assert len(huge_n) > 1024**2
+        resp = await client.post(
+            "/score/completions",
+            data=huge_n,
+            headers={"content-type": "application/json"},
+        )
+        assert resp.status == 413
+        envelope = await resp.json()
+        assert envelope["code"] == 413
+        assert envelope["message"]["kind"] == "payload_too_large"
+
+    go(with_client(app, run))
+
+
+def test_fleet_routes_ride_the_same_body_cap():
+    from llm_weighted_consensus_tpu.fleet import (
+        FleetConfig,
+        FleetCoordinator,
+    )
+
+    me = "http://127.0.0.1:1"
+    fleet = FleetCoordinator(FleetConfig(self_url=me, peers=[me]))
+    fleet.cache = ScoreCache(60, 1 << 20)
+    app = make_capped_app(1024, fleet=fleet)
+
+    async def run(client):
+        resp = await client.post(
+            "/fleet/v1/handoff",
+            data=b"y" * 4096,
+            headers={"content-type": "application/json"},
+        )
+        assert resp.status == 413
+        envelope = await resp.json()
+        assert envelope["message"]["kind"] == "payload_too_large"
+
+    try:
+        go(with_client(app, run))
+    finally:
+        go(fleet.close())

@@ -6,9 +6,12 @@ The native library builds on demand (``make -C native``); tests skip if the
 toolchain can't produce it.
 """
 
+import pathlib
+
 import pytest
 
 from llm_weighted_consensus_tpu.clients import sse
+from llm_weighted_consensus_tpu.errors import IngestCapError
 
 CORPUS = [
     # (name, raw bytes, expected events, expected flush tail)
@@ -205,6 +208,108 @@ def test_native_parser_is_on_the_chat_client_path(native_lib):
     src = inspect.getsource(chat)
     assert "make_parser()" in src
     assert isinstance(sse.make_parser(), sse.NativeSSEParser)
+
+
+# -- byte-budget cap parity (ISSUE 19 ingest plane) ---------------------------
+#
+# Trip semantics are part of the Python/native parity contract: same
+# events before the trip, same trip kind at the same observed byte
+# boundary, same dropped state, and both parsers stay usable after.
+# Driven over the committed hostile corpus (tests/fixtures/ingest/).
+
+INGEST_CORPUS = pathlib.Path(__file__).parent / "fixtures" / "ingest"
+
+CAP_FILES = [
+    "giant_line.sse",
+    "newline_less_flood.bin",
+    "binary_garbage.bin",
+    "interleaved.sse",
+]
+CAP_SPLITS = [1, 7, 1 << 30]
+CAP_CONFIGS = [(4096, 0), (0, 4096), (4096, 4096)]
+
+
+def run_capped(parser, raw: bytes, split: int):
+    """Feed chunked bytes through a capped parser; collect everything
+    observable: events, flush tail, every trip (kind + observed bytes),
+    the trip counter, and a usable-after-trip probe event."""
+    events, trips = [], []
+    for i in range(0, len(raw), split):
+        try:
+            for event in parser.feed(raw[i : i + split]):
+                events.append(event)
+        except IngestCapError as e:
+            trips.append((e.what, e.observed_bytes))
+    try:
+        tail = parser.flush()
+    except IngestCapError as e:
+        trips.append((e.what, e.observed_bytes))
+        tail = None
+    probe = list(parser.feed(b"\n\ndata: after-trip\n\n"))
+    return events, tail, trips, parser.cap_trips, probe
+
+
+@pytest.mark.parametrize(
+    "buf_cap,ev_cap", CAP_CONFIGS, ids=["buffer", "event", "both"]
+)
+@pytest.mark.parametrize("split", CAP_SPLITS)
+@pytest.mark.parametrize("name", CAP_FILES)
+def test_parsers_agree_on_cap_trips(
+    native_lib, name, split, buf_cap, ev_cap
+):
+    raw = (INGEST_CORPUS / name).read_bytes()
+    py = run_capped(
+        sse.SSEParser(max_buffer_bytes=buf_cap, max_event_bytes=ev_cap),
+        raw,
+        split,
+    )
+    nat = run_capped(
+        sse.NativeSSEParser(
+            native_lib, max_buffer_bytes=buf_cap, max_event_bytes=ev_cap
+        ),
+        raw,
+        split,
+    )
+    assert py == nat, f"{name} split={split} caps=({buf_cap},{ev_cap})"
+
+
+def test_parsers_agree_on_capped_random_streams(native_lib):
+    import random
+
+    rng = random.Random(19)
+    for trial in range(30):
+        # random mix of healthy lines, giant lines and newline-less runs
+        parts = []
+        for _ in range(rng.randint(1, 12)):
+            roll = rng.random()
+            if roll < 0.5:
+                parts.append(b"data: ok %d\n\n" % rng.randint(0, 99))
+            elif roll < 0.75:
+                parts.append(
+                    b"data: " + b"A" * rng.randint(100, 700) + b"\n\n"
+                )
+            else:
+                parts.append(b"B" * rng.randint(100, 700))
+        raw = b"".join(parts)
+        split = rng.choice([1, 3, 17, len(raw) or 1])
+        caps = rng.choice(CAP_CONFIGS + [(256, 256)])
+        py = run_capped(
+            sse.SSEParser(
+                max_buffer_bytes=caps[0], max_event_bytes=caps[1]
+            ),
+            raw,
+            split,
+        )
+        nat = run_capped(
+            sse.NativeSSEParser(
+                native_lib,
+                max_buffer_bytes=caps[0],
+                max_event_bytes=caps[1],
+            ),
+            raw,
+            split,
+        )
+        assert py == nat, f"trial {trial}: caps={caps} {raw!r}"
 
 
 # -- native WordPiece (ASCII fast path) ---------------------------------------

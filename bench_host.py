@@ -837,6 +837,63 @@ def ingest_bounds_record(args) -> dict:
     return record
 
 
+def _host_speed_canary(reps: int = 2000) -> float:
+    """Median per-rep cost (µs) of a FIXED pure-python workload — the
+    machine-speed reference for the budget gate.  The mix is the same
+    primitives the gated phases spend their time in (compact json
+    encode/decode, a precompiled regex scan, dict/list churn), so host
+    CPU throttling that slows the phases slows the canary by the same
+    factor.  It touches none of the engine's code, so a code regression
+    cannot hide inside it.  The default reps span ~1-2 s — long enough
+    to integrate over the second-granularity throttle bursts this box
+    exhibits instead of sampling one by luck."""
+    import re as re_mod
+
+    pat = re_mod.compile(r"\b(cand_[0-9]+)\b")
+    text = " ".join(f"cand_{i} token{i}" for i in range(64))
+    obj = {
+        "choices": [
+            {"index": i, "delta": {"content": f"tok {i}"}}
+            for i in range(16)
+        ]
+    }
+
+    # task-switch component: a bounded queue forces producer/consumer
+    # alternation per item — the same call_soon hop merge_streams pays
+    # per chunk, and the piece that degrades hardest under CPU steal
+    # (the scheduler-sensitive phases inflate more than straight-line
+    # code, so a pure-CPU canary undertracks them)
+    async def _pump(n):
+        q = asyncio.Queue(maxsize=1)
+
+        async def producer():
+            for i in range(n):
+                await q.put(i)
+
+        task = asyncio.ensure_future(producer())
+        for _ in range(n):
+            await q.get()
+        await task
+
+    loop = asyncio.new_event_loop()
+    try:
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(8):
+                blob = json.dumps(obj, separators=(",", ":"))
+                json.loads(blob)
+                pat.findall(text)
+                sorted(
+                    range(256), key=lambda v: (v * 2654435761) & 0xFFFF
+                )
+            loop.run_until_complete(_pump(8))
+            samples.append((time.perf_counter() - t0) * 1e6)
+    finally:
+        loop.close()
+    return statistics.median(samples)
+
+
 def hostpath_record(args, write_budgets: bool = False) -> dict:
     """--hostpath: per-chunk host-path p50 per phase (ingest / merge /
     tally / encode), HOST_FASTPATH unset vs set, over REAL engine
@@ -905,14 +962,26 @@ def hostpath_record(args, write_budgets: bool = False) -> dict:
         loop = asyncio.new_event_loop()
         # warmup + capture one REAL stream's chunks for the encode phase
         chunks = loop.run_until_complete(score_one(texts_per_request[0]))
-        # tally: the engine's own host_tally phase histogram (weighted
-        # fold + final-frame build) over the remaining real requests
-        phases_mod.reset_phases()
+        # tally: the engine's own host_tally phase (weighted fold +
+        # final-frame build), one EXACT value per request — reset the
+        # phase store around each request so its ``sum_ms`` (count=1)
+        # is the raw observation, then take the median.  Reading the
+        # aggregate histogram's p50 instead would quantize to the
+        # log-spaced buckets, which step ~19-41% apiece: one bucket up
+        # overshoots the whole 25% budget band while the true p50
+        # moved a few percent.
+        tally_samples = []
         for texts in texts_per_request[1:]:
+            phases_mod.reset_phases()
             loop.run_until_complete(score_one(texts))
+            row = phases_mod.phases_snapshot().get("host_tally") or {}
+            tally_samples.append(row.get("sum_ms", 0.0))
         loop.close()
-        tally_row = phases_mod.phases_snapshot().get("host_tally") or {}
-        tally_ms = tally_row.get("p50_ms", 0.0)
+        tally_ms = (
+            round(statistics.median(tally_samples), 3)
+            if tally_samples
+            else 0.0
+        )
 
         # encode: FrameEncoder over the captured stream, per-frame p50
         # over reps (fresh encoder per rep = fresh splice cache, exactly
@@ -1004,7 +1073,15 @@ def hostpath_record(args, write_budgets: bool = False) -> dict:
             "frames_per_stream": n_frames,
         }
 
+    # machine-speed canary, sampled BEFORE, BETWEEN, and AFTER the
+    # ~60 s of lane measurement: the gate scales by the slowest sample
+    # (the most-throttled view of the window the phases were measured
+    # in — throttle bursts last seconds, so end-points alone can miss
+    # a mid-run burst); --write-budgets records the fastest (the
+    # healthy-floor baseline)
+    canary_pre = _host_speed_canary()
     slow = measure_lane(False)
+    canary_mid = _host_speed_canary()
     fast = measure_lane(True)
     ratio = round(
         slow["per_chunk_p50_us"] / fast["per_chunk_p50_us"], 2
@@ -1065,20 +1142,38 @@ def hostpath_record(args, write_budgets: bool = False) -> dict:
                 "(DESIGN.md 'Host fast path')."
             ),
             "phases": {k: fast[k] for k in gated_phases},
+            "canary_us": round(
+                min(canary_pre, canary_mid, _host_speed_canary()), 2
+            ),
         }
         with open(budgets_path, "w") as fh:
             json.dump(budgets, fh, indent=2, sort_keys=True)
             fh.write("\n")
         within_budget = True
         budget_detail = {"written": budgets_path}
+        machine_scale = 1.0
+        canary_us = budgets["canary_us"]
     else:
         with open(budgets_path) as fh:
             budgets = json.load(fh)
         band = budgets["band"]
+        # machine-speed scaling: shared-host CPU throttling swings this
+        # box well past the 1.25 band (observed ~1.4x for minutes at a
+        # stretch), which fails EVERY phase at once with no code change.
+        # Re-measure the fixed canary workload now and widen the limits
+        # by the same global slowdown (capped, never narrowed): a true
+        # host-path regression inflates its phase WITHOUT moving the
+        # canary, so phase-relative regressions still trip.
+        canary_us = max(canary_pre, canary_mid, _host_speed_canary())
+        baseline_canary = budgets.get("canary_us")
+        if baseline_canary:
+            machine_scale = min(2.0, max(1.0, canary_us / baseline_canary))
+        else:
+            machine_scale = 1.0
         budget_detail = {}
         within_budget = True
         for k in gated_phases:
-            limit = budgets["phases"][k] * band
+            limit = budgets["phases"][k] * band * machine_scale
             ok = fast[k] <= limit
             budget_detail[k] = {
                 "measured": fast[k],
@@ -1099,6 +1194,8 @@ def hostpath_record(args, write_budgets: bool = False) -> dict:
         "within_budget": within_budget,
         "budget_band": budgets["band"],
         "budget_detail": budget_detail,
+        "canary_us": round(canary_us, 2),
+        "machine_scale": round(machine_scale, 3),
         "slow_lane": slow,
         "fast_lane": fast,
         "embed_assembly": embed_assembly,

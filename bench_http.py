@@ -83,6 +83,17 @@ acceptance >= 0.95) and the ``overlap`` gauge — device-busy union over
 wall — read from the timing-on service over a saturated burst
 (acceptance >= 0.8).
 
+``--offline`` replaces the trio with the priority-class scenario
+(serve/batcher.py two-lane scheduler + train/feed.py): one service with
+``OFFLINE_ENABLED=1``, measured in three phases — an idle-mesh
+``POST /v1/train/rescore`` drive (the offline lane alone; its merged
+device occupancy is the near-100%-on-an-idle-mesh acceptance gauge), a
+closed-loop /consensus baseline with the offline lane quiet, and the
+SAME /consensus drive with a saturating rescore running concurrently.
+The number that matters is the contended-vs-baseline latency p99
+inflation: offline work yields at dispatch boundaries, so the latency
+lane must pay at most one in-flight offline dispatch (<10%).
+
 ``--fleet`` replaces the trio with the fleet-tier scenario (fleet/):
 THREE replicas on real localhost sockets sharing a static
 ``FLEET_PEERS`` roster and ONE counting fake upstream, driven through
@@ -1294,6 +1305,230 @@ async def bench_mesh_faults(args) -> None:
     )
 
 
+async def bench_offline(args) -> None:
+    """Priority-class scheduling (ISSUE 20): does a saturated offline
+    lane actually stay out of the latency lane's way?  One service with
+    ``OFFLINE_ENABLED=1``; the rescore drives go through the REAL
+    ``POST /v1/train/rescore`` endpoint so the whole seam (handler lock,
+    synthetic feed, bounded-inflight drive, lane accounting) is inside
+    the measured path.
+
+    Phase A — idle occupancy: one rescore drive with no latency traffic;
+    its ``offline_occupancy`` (merged busy coverage of the offline lane
+    over the drive window) is the near-100%-on-an-idle-mesh acceptance
+    gauge.  Phase B — the closed-loop /consensus baseline, offline lane
+    quiet.  Phase C — the SAME /consensus drive with a saturating
+    rescore running concurrently.  Offline work is preemptible at
+    dispatch boundaries only, so an admitted latency request pays at
+    most one in-flight offline dispatch: acceptance is contended p99
+    within 10% of baseline while the offline lane still makes progress
+    (contended-phase offline dispatches > 0)."""
+    import aiohttp
+
+    n_latency = max(2, min(args.n, 8))
+    concurrency = min(args.concurrency, 8)
+    offline_n = 4
+    rounds = 5
+    runner, fake_runner, port, embedder, _ = await _start_service(
+        args.model,
+        args.window_ms,
+        args.quantize,
+        # pipeline depth 1 makes the preemption quantum literally the
+        # scheduler's contract — ONE in-flight dispatch: at depth 2 a
+        # latency arrival can land behind two already-running offline
+        # dispatches, and the measured inflation would charge the
+        # pipeline, not the planner
+        extra_env={
+            "OFFLINE_ENABLED": "1",
+            "OFFLINE_INFLIGHT": "4",
+            "BATCH_PIPELINE": "1",
+        },
+    )
+    base = f"http://127.0.0.1:{port}"
+
+    reqs = make_requests(args.requests, n_latency)
+    bodies = [
+        json.dumps({"input": texts, "temperature": 0.05}) for texts in reqs
+    ]
+
+    # compile every latency R bucket up-front (the trio's discipline):
+    # the contended phase's batching dynamics produce group sizes the
+    # baseline never formed, and a mid-window jit compile would be
+    # charged to the scheduler
+    loop = asyncio.get_running_loop()
+    ids, mask = embedder.tokenize(reqs[0])
+    r_bucket = 1
+    while True:
+        r_eff = min(r_bucket, concurrency)
+        rep_ids = np.tile(ids[None], (r_eff, 1, 1))
+        rep_mask = np.tile(mask[None], (r_eff, 1, 1))
+        await loop.run_in_executor(
+            None,
+            lambda ri=rep_ids, rm=rep_mask: np.asarray(
+                embedder.consensus_confidence_tokens_many(ri, rm, 0.05)
+            ),
+        )
+        if r_bucket >= concurrency:
+            break
+        r_bucket *= 2
+
+    async def rescore(session, groups, seed, inflight=4):
+        async with session.post(
+            base + "/v1/train/rescore",
+            data=json.dumps(
+                {"groups": groups, "n": offline_n, "inflight": inflight,
+                 "seed": seed},
+            ),
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            return await resp.json()
+
+    async def lane_counters(session):
+        async with session.get(base + "/metrics") as resp:
+            return (await resp.json())["device_batcher"]["lanes"]
+
+    try:
+        async with aiohttp.ClientSession(
+            headers={"content-type": "application/json"}
+        ) as session:
+            # phase A — idle-mesh occupancy.  The first drive pays the
+            # offline group shape's jit compiles (inside busy intervals,
+            # so occupancy stays honest either way); the second is the
+            # reported steady-state gauge, with enough in-flight groups
+            # (inflight=8) for back-to-back dispatches to pipeline.
+            await rescore(session, max(16, args.requests // 2), seed=1)
+            idle = await rescore(
+                session, max(48, args.requests), seed=2, inflight=8
+            )
+
+            # phases B and C, interleaved (baseline, contended,
+            # baseline, ...): the per-round signal — one in-flight
+            # offline dispatch of tail latency — sits below fresh-run
+            # drift, so a median over alternating rounds is the same
+            # discipline the trace-overhead scenario uses
+            base_p50s, base_p99s, base_lat = [], [], []
+            cont_p50s, cont_p99s, cont_lat = [], [], []
+            base_rps, cont_rps = [], []
+            offline_dispatches_during = 0
+            contended_rescore = None
+            # round 0 is a full warmup pass, discarded: the first
+            # CONTENDED round compiles whatever group shapes only the
+            # mixed workload produces (staggered latency arrivals form
+            # R buckets the quiet baseline never does), and that
+            # one-time compile would otherwise be the pooled p99
+            for rnd in range(rounds + 1):
+                record = rnd > 0
+                total, lat = await _drive(
+                    session, base + "/consensus", bodies, concurrency,
+                    warmup_bursts=2 if rnd == 0 else 0,
+                )
+                if record:
+                    base_p50s.append(_quantile(lat, 0.50))
+                    base_p99s.append(_quantile(lat, 0.99))
+                    base_rps.append(len(lat) / total)
+                    base_lat.extend(lat)
+
+                # the offline lane saturated: a large rescore launched
+                # first and still running while every timed latency
+                # request flows.  inflight=2 keeps the queue non-empty
+                # (each completion resubmits) while keeping the
+                # preemption quantum — ONE in-flight offline dispatch,
+                # the scheduler's contract — small; a deployment tunes
+                # OFFLINE_INFLIGHT exactly this way
+                lanes_before = await lane_counters(session)
+                rescore_task = asyncio.ensure_future(
+                    rescore(
+                        session,
+                        max(64, 4 * args.requests),
+                        seed=3 + rnd,
+                        inflight=2,
+                    )
+                )
+                await asyncio.sleep(0.05)  # the drive is in flight
+                total, lat = await _drive(
+                    session, base + "/consensus", bodies, concurrency,
+                    warmup_bursts=0,
+                )
+                contended_rescore = await rescore_task
+                lanes_after = await lane_counters(session)
+                if record:
+                    cont_p50s.append(_quantile(lat, 0.50))
+                    cont_p99s.append(_quantile(lat, 0.99))
+                    cont_rps.append(len(lat) / total)
+                    cont_lat.extend(lat)
+                    offline_dispatches_during += (
+                        lanes_after["offline"]["dispatches"]
+                        - lanes_before["offline"]["dispatches"]
+                    )
+    finally:
+        await runner.cleanup()
+        await fake_runner.cleanup()
+
+    # headline percentiles over the POOLED samples (rounds x requests):
+    # a single round's p99 is one order statistic of ~requests samples
+    # and swings +-20% between identical baseline rounds; the per-round
+    # p99s ride along as the drift record
+    base_p = {
+        "p50_ms": statistics.median(base_p50s),
+        "p99_ms": _quantile(base_lat, 0.99),
+        "round_p99s_ms": base_p99s,
+    }
+    cont_p = {
+        "p50_ms": statistics.median(cont_p50s),
+        "p99_ms": _quantile(cont_lat, 0.99),
+        "round_p99s_ms": cont_p99s,
+    }
+    emit(
+        "/consensus?offline",
+        (
+            round(cont_p["p99_ms"] / base_p["p99_ms"], 3)
+            if base_p["p99_ms"]
+            else 0.0
+        ),
+        "contended/baseline p99 ratio",
+        requests=len(bodies),
+        concurrency=concurrency,
+        n_candidates=n_latency,
+        offline_n=offline_n,
+        rounds=rounds,
+        offline_occupancy_idle=idle["offline_occupancy"],
+        idle_rescore=idle,
+        baseline={
+            "rps": round(statistics.median(base_rps), 3),
+            **base_p,
+        },
+        contended={
+            "rps": round(statistics.median(cont_rps), 3),
+            **cont_p,
+        },
+        p99_inflation_pct=(
+            round((cont_p["p99_ms"] / base_p["p99_ms"] - 1.0) * 100.0, 2)
+            if base_p["p99_ms"]
+            else None
+        ),
+        p50_inflation_pct=(
+            round((cont_p["p50_ms"] / base_p["p50_ms"] - 1.0) * 100.0, 2)
+            if base_p["p50_ms"]
+            else None
+        ),
+        offline_dispatches_during_contention=offline_dispatches_during,
+        contended_rescore={
+            k: contended_rescore[k]
+            for k in ("groups", "items", "errors", "offline_occupancy")
+        },
+        lanes=lanes_after,
+        note=(
+            "one OFFLINE_ENABLED=1 service; idle = POST /v1/train/rescore "
+            "alone (offline_occupancy_idle is the near-100% idle-mesh "
+            "acceptance gauge); contended = the same closed-loop "
+            "/consensus drive with a saturating rescore in flight; "
+            "acceptance = p99_inflation_pct < 10 (offline yields at "
+            "dispatch boundaries) with "
+            "offline_dispatches_during_contention > 0"
+        ),
+    )
+
+
 async def bench_fleet(args) -> None:
     """Fleet-tier goodput (fleet/): three replicas on real localhost
     sockets, one shared counting fake upstream — cold / warm (every hit
@@ -1684,6 +1919,9 @@ async def main_async(args) -> None:
     if args.fleet:
         await bench_fleet(args)
         return
+    if args.offline:
+        await bench_offline(args)
+        return
     overload_env = None
     if args.overload:
         overload_env = {
@@ -1828,6 +2066,16 @@ def main() -> None:
         "METRICS_DEVICE_TIMING=1 vs =0 services (BATCH_PIPELINE=2); "
         "reports the goodput ratio (acceptance >= 0.95) and the overlap "
         "gauge over a saturated burst (acceptance >= 0.8)",
+    )
+    parser.add_argument(
+        "--offline",
+        action="store_true",
+        help="run the priority-class scenario instead of the endpoint "
+        "trio: OFFLINE_ENABLED=1 service, idle-mesh /v1/train/rescore "
+        "occupancy, then closed-loop /consensus baseline vs the same "
+        "drive with a saturating rescore concurrent; acceptance = "
+        "contended p99 within 10%% of baseline, idle offline occupancy "
+        "near 100%%",
     )
     parser.add_argument(
         "--fleet",

@@ -406,6 +406,7 @@ class ScoreClient:
         ledger=None,
         fleet=None,
         host_fastpath: bool = False,
+        live_weights=None,
     ) -> None:
         self.chat_client = chat_client
         self.model_fetcher = model_fetcher
@@ -440,6 +441,11 @@ class ScoreClient:
         # the fleet — peer cache fetch or a cross-replica lease — so a
         # fleet-wide hot fingerprint hits upstream exactly once
         self.fleet = fleet
+        # optional weights.live.LiveWeightStore (WEIGHTS_*): versioned
+        # per-judge weight overrides behind atomic hot-swap, with the
+        # applied version stamped on every tally span + ledger record
+        # and shadow-table counters feeding the quality scorecards
+        self.live_weights = live_weights
         # HOST_FASTPATH: run the tally fold on scaled-int64 numpy vectors
         # (clients/tally.py) and hoist the per-candidate share divisions;
         # off = the Decimal loops below, byte-identical either way — any
@@ -605,6 +611,17 @@ class ScoreClient:
         except ResponseError as e:
             raise FetchModelWeightsError(e) from e
 
+        # live weight overrides (weights/live.py): the (weights, version)
+        # pair is captured HERE, in one store read, and threaded through
+        # the whole stream — a hot swap mid-request can never mix two
+        # versions inside one tally, and the stamped version is always
+        # the one that actually scored the request
+        weights_version = None
+        if self.live_weights is not None:
+            weights, weights_version = self.live_weights.apply(
+                model, weights
+            )
+
         initial_chunk = self._initial_chunk(
             resp_id, created, model, internal_choices
         )
@@ -618,6 +635,7 @@ class ScoreClient:
             weight_data,
             initial_chunk,
             n_choices,
+            weights_version=weights_version,
         )
 
     def _initial_chunk(
@@ -664,6 +682,7 @@ class ScoreClient:
         weight_data,
         initial_chunk,
         n_choices,
+        weights_version=None,
     ):
         # usage seeded by embeddings evidence for trained weights
         # (client.rs:330-337)
@@ -1013,8 +1032,18 @@ class ScoreClient:
                 weight_sum=float(weight_sum),
                 winner=winner,
                 degraded=degraded,
+                **(
+                    {"weights_version": weights_version}
+                    if weights_version is not None
+                    else {}
+                ),
             )
             tspan.finish()
+        if self.live_weights is not None:
+            # shadow-mode comparison (weights/live.py): re-tally the
+            # same ballots under the staged table; pure observation,
+            # the served result above is already final
+            self.live_weights.observe_shadow(quality_ballots, n_choices)
         trace_id = obs.current_trace_id()
         # consensus-quality aggregates: scorecards, pairwise agreement,
         # drift windows, margin histogram (obs/quality.py) — always on,
@@ -1049,6 +1078,10 @@ class ScoreClient:
                     "quorum_degraded": quorum_degraded,
                     "all_failed": all_failed,
                     "trace_id": trace_id,
+                    # which weight-table version scored this request —
+                    # "base" when no live table was active, so the
+                    # learner can partition its substrate by version
+                    "weights_version": weights_version,
                     "judges": ledger_judges,
                 }
             )

@@ -35,8 +35,10 @@ from typing import Callable, Optional
 # /v1/profile rides the probe exemption too: an on-demand profiler
 # capture is exactly the tool for diagnosing an overload, so the gate
 # must not shed it (serve/gateway.py guards it behind PROFILE_DIR)
+# /v1/weights likewise: promoting a fitted table is a tiny admin swap an
+# operator may need mid-overload, and shedding it can't relieve load
 EXEMPT_PATHS = frozenset(
-    {"/healthz", "/livez", "/readyz", "/metrics", "/v1/profile"}
+    {"/healthz", "/livez", "/readyz", "/metrics", "/v1/profile", "/v1/weights"}
 )
 
 # endpoints whose handler requires a live device forward: when the

@@ -1170,6 +1170,17 @@ def build_service(
     # LEDGER_*: per-request consensus-outcome records (obs/ledger.py);
     # None keeps the tally ledger-free
     ledger = config.outcome_ledger()
+    # WEIGHTS_*: versioned live judge-weight tables behind atomic
+    # hot-swap (weights/live.py); None keeps static-weight behavior
+    live_weights = config.live_weights()
+    if live_weights is not None and config.weights_path:
+        from ..utils.io import probe_writable_config
+
+        probe_writable_config(
+            config.weights_path,
+            "WEIGHTS_PATH",
+            "hot-swapped weight tables would be lost at shutdown",
+        )
     score_client = ScoreClient(
         chat_client,
         model_registry,
@@ -1191,6 +1202,7 @@ def build_service(
         fleet=fleet,
         # HOST_FASTPATH: fixed-point vectorized tally (clients/tally.py)
         host_fastpath=config.host_fastpath,
+        live_weights=live_weights,
     )
     multichat_client = MultichatClient(
         chat_client, model_registry, archive_fetcher=store
@@ -1275,6 +1287,11 @@ def build_service(
         # MAX_BODY_BYTES: aiohttp client_max_size — every route,
         # /fleet/v1 included, 413s render the payload_too_large envelope
         max_body_bytes=config.max_body_bytes,
+        # WEIGHTS_* / OFFLINE_*: live weight hot-swap endpoints and the
+        # offline-lane rescore driver (ISSUE 20)
+        live_weights=live_weights,
+        offline_enabled=config.offline_enabled,
+        offline_inflight=config.offline_inflight,
     )
     app[ARCHIVE_KEY] = store
     # one lock for every handler that mutates the archive/tables

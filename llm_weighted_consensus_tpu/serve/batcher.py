@@ -64,14 +64,21 @@ from ..models import dispatch_seam as _seam
 class _Item:
     __slots__ = (
         "kind", "key", "payload", "future", "deadline", "span",
-        "redispatches", "submitted", "prepared",
+        "redispatches", "submitted", "prepared", "lane",
     )
 
-    def __init__(self, kind, key, payload, future, deadline=None, span=None):
+    def __init__(
+        self, kind, key, payload, future, deadline=None, span=None,
+        lane="latency",
+    ):
         self.kind = kind
         self.key = key
         self.payload = payload
         self.future = future
+        # priority class (ISSUE 20): "latency" rides the request path,
+        # "offline" (train/ feed work) dispatches only when the latency
+        # lane has no ready group — the two queues never mix in a group
+        self.lane = lane
         # enqueue timestamp: _run_group attributes (dispatch start -
         # submitted) to the ``batcher_queue`` phase per item, including
         # any fault re-queue wait (obs/phases.py)
@@ -230,6 +237,14 @@ class DeviceBatcher:
         self._base_max_rows = self.max_rows
         self._base_max_batch = self.max_batch
         self._pending: list = []
+        # offline priority class (ISSUE 20): a separate queue the group
+        # planner only draws from when the latency queue is empty.
+        # Preemption happens at dispatch boundaries for free — groups
+        # are planned one at a time after each pipeline-slot acquire,
+        # so a latency arrival waits behind at most the offline
+        # dispatches already in flight (<= 1 extra slot wait), never
+        # behind queued offline work
+        self._pending_offline: list = []
         self._flusher: Optional[asyncio.Task] = None
         self._sem: Optional[asyncio.Semaphore] = None
         # set by _submit so a parked _drain starts new work immediately
@@ -265,11 +280,22 @@ class DeviceBatcher:
             pool.per_bucket = self.staging_buffers
         # recent device-dispatch intervals, for the busy-fraction gauge
         self._busy: deque = deque(maxlen=1024)
-        # start times of dispatches currently in flight (pipelined: >1)
+        # (start time, lane) of dispatches currently in flight
         self._inflight: dict = {}
         self._started = time.perf_counter()
         self._dispatches = 0
         self._items = 0
+        # per-lane accounting (ISSUE 20): dispatches/items counters plus
+        # a busy-interval ring per priority class, so /metrics exposes
+        # per-class utilization/occupancy.  Event-loop-only like the
+        # combined counters above — _observe is the sole writer — so no
+        # lock (and no concurrency_model.py registry row) is needed
+        self._lane_dispatches = {"latency": 0, "offline": 0}
+        self._lane_items = {"latency": 0, "offline": 0}
+        self._lane_busy = {
+            "latency": deque(maxlen=1024),
+            "offline": deque(maxlen=1024),
+        }
         if metrics is not None:
             metrics.register_provider("device_batcher", self.utilization)
             if embed_cache is not None:
@@ -284,7 +310,12 @@ class DeviceBatcher:
 
     # -- public async API ----------------------------------------------------
 
-    async def embed(self, texts: list, max_tokens: Optional[int] = None):
+    async def embed(
+        self,
+        texts: list,
+        max_tokens: Optional[int] = None,
+        priority: str = "latency",
+    ):
         """texts -> (embeddings[N, H] f32, token_count).  Batches with every
         other embed request sharing the same ``max_tokens`` cap.
 
@@ -292,7 +323,11 @@ class DeviceBatcher:
         BEFORE batching: cached rows skip the device entirely, rows
         already being computed by a concurrent request are joined rather
         than recomputed, and only genuinely new rows ride a dispatch.
-        The public contract is unchanged either way."""
+        The public contract is unchanged either way.
+
+        ``priority="offline"`` routes the item through the offline
+        class: it dispatches only when the latency lane has no ready
+        group (train/ feed work riding an otherwise-idle device)."""
         texts = list(texts)
         if await self._route_ring(texts, max_tokens):
             # over-length request on a sequence-parallel mesh: the ring
@@ -302,14 +337,17 @@ class DeviceBatcher:
             # full-length vector under the same (text, cap) key would
             # poison dense hits (and vice versa).
             emb, row_tokens = await self._submit(
-                "ring_embed", ("ring_embed", max_tokens), (texts, max_tokens)
+                "ring_embed",
+                ("ring_embed", max_tokens),
+                (texts, max_tokens),
+                priority=priority,
             )
             return emb, int(np.asarray(row_tokens).sum())
         key = self._embed_key(max_tokens)
         cache = self.embed_cache
         if cache is None or not cache.enabled or not texts:
             emb, row_tokens = await self._submit(
-                "embed", key, (texts, max_tokens)
+                "embed", key, (texts, max_tokens), priority=priority
             )
             return emb, int(np.asarray(row_tokens).sum())
         from ..cache.fingerprint import embed_fingerprint
@@ -341,7 +379,10 @@ class DeviceBatcher:
         if submit_texts:
             try:
                 emb, row_tokens = await self._submit(
-                    "embed", key, (submit_texts, max_tokens)
+                    "embed",
+                    key,
+                    (submit_texts, max_tokens),
+                    priority=priority,
                 )
             except BaseException as e:
                 for fp in submit_fps:
@@ -379,6 +420,7 @@ class DeviceBatcher:
                 "embed",
                 key,
                 ([texts[i] for i in retry], max_tokens),
+                priority=priority,
             )
             row_tokens = np.asarray(row_tokens)
             for j, i in enumerate(retry):
@@ -388,7 +430,12 @@ class DeviceBatcher:
             int(sum(r[1] for r in rows)),
         )
 
-    async def consensus(self, texts: list, temperature: float = 0.05):
+    async def consensus(
+        self,
+        texts: list,
+        temperature: float = 0.05,
+        priority: str = "latency",
+    ):
         """N candidate texts -> (confidence[N], token_count): embed +
         cosine consensus vote in one fused dispatch, with the prompt
         token count from the SAME tokenization (callers must not
@@ -408,6 +455,7 @@ class DeviceBatcher:
                 "ring_vote",
                 ("ring_vote", len(texts), float(temperature)),
                 (texts, temperature),
+                priority=priority,
             )
         key = (
             ("packed",)
@@ -418,6 +466,7 @@ class DeviceBatcher:
             "consensus",
             key,
             (texts, temperature),
+            priority=priority,
         )
 
     def _embed_key(self, max_tokens):
@@ -511,9 +560,11 @@ class DeviceBatcher:
         self.max_batch = max(1, int(self._base_max_batch * scale))
 
     def idle(self) -> bool:
-        """No pending items and no dispatch in flight."""
+        """No pending items (either priority class) and no dispatch in
+        flight."""
         return (
             not self._pending
+            and not self._pending_offline
             and not self._inflight
             and (self._flusher is None or self._flusher.done())
         )
@@ -535,13 +586,17 @@ class DeviceBatcher:
     def utilization(self, window_sec: float = 60.0) -> dict:
         now = time.perf_counter()
         lo = now - window_sec
-        busy = sum(
-            max(0.0, min(end, now) - max(start, lo))
-            for start, end in self._busy
-        )
-        for start in self._inflight.values():
-            busy += now - max(start, lo)
         span = max(min(window_sec, now - self._started), 1e-9)
+
+        def busy_fraction(intervals, inflight_lane=None):
+            busy = sum(
+                max(0.0, min(end, now) - max(start, lo))
+                for start, end in intervals
+            )
+            for start, lane in self._inflight.values():
+                if inflight_lane is None or lane == inflight_lane:
+                    busy += now - max(start, lo)
+            return round(min(busy / span, 1.0), 4)
         # consistent counter snapshot: the dispatch workers mutate these
         # under the same lock; the staging-pool stats() call below stays
         # OUTSIDE it (the pool has its own lock — no nesting, no edge)
@@ -557,7 +612,25 @@ class DeviceBatcher:
             fallback_dispatches = self.fallback_dispatches
         return {
             "queue_depth": len(self._pending),
-            "busy_fraction": round(min(busy / span, 1.0), 4),
+            "busy_fraction": busy_fraction(self._busy),
+            # per-priority-class utilization (ISSUE 20): the offline
+            # lane's occupancy is the acceptance gauge for the train/
+            # feed drill (>= 90% on an otherwise-idle mesh)
+            "lanes": {
+                lane: {
+                    "queue_depth": len(
+                        self._pending
+                        if lane == "latency"
+                        else self._pending_offline
+                    ),
+                    "dispatches": self._lane_dispatches[lane],
+                    "items": self._lane_items[lane],
+                    "busy_fraction": busy_fraction(
+                        self._lane_busy[lane], inflight_lane=lane
+                    ),
+                }
+                for lane in ("latency", "offline")
+            },
             "dispatches": self._dispatches,
             "items": self._items,
             "items_per_dispatch": round(
@@ -610,16 +683,61 @@ class DeviceBatcher:
             },
         }
 
+    def lane_occupancy(
+        self, lane: str, since: float, until: Optional[float] = None
+    ) -> float:
+        """Fraction of ``[since, until]`` the device had ``lane`` work
+        in flight, with overlapping pipelined intervals MERGED (unlike
+        the clamped busy-fraction gauge, this is an honest coverage
+        measure — the acceptance gauge for the offline-occupancy
+        drill).  Event-loop read over event-loop-written state."""
+        now = time.perf_counter() if until is None else until
+        window = now - since
+        if window <= 0:
+            return 0.0
+        intervals = [
+            (max(start, since), min(end, now))
+            for start, end in self._lane_busy.get(lane, ())
+            if end > since and start < now
+        ]
+        intervals += [
+            (max(start, since), now)
+            for start, inflight_lane in self._inflight.values()
+            if inflight_lane == lane and start < now
+        ]
+        if not intervals:
+            return 0.0
+        intervals.sort()
+        covered = 0.0
+        cur_lo, cur_hi = intervals[0]
+        for lo, hi in intervals[1:]:
+            if lo > cur_hi:
+                covered += cur_hi - cur_lo
+                cur_lo, cur_hi = lo, hi
+            else:
+                cur_hi = max(cur_hi, hi)
+        covered += cur_hi - cur_lo
+        return round(min(covered / window, 1.0), 4)
+
     # -- internals -----------------------------------------------------------
 
-    async def _submit(self, kind, key, payload):
+    async def _submit(self, kind, key, payload, priority="latency"):
         from .. import obs
 
+        offline = priority == "offline"
         # enqueue -> result wall time for THIS request's item; created
         # here (the submitting task still carries the request context)
-        span = obs.child_span(f"batcher:{kind}", queue_depth=len(self._pending))
+        span = obs.child_span(
+            f"batcher:{kind}",
+            queue_depth=len(self._pending),
+            **({"lane": "offline"} if offline else {}),
+        )
+        # the queue-depth shed guards the LATENCY lane only: offline
+        # feeders self-limit by awaiting their futures, and shedding
+        # background work with a 503 would just make the drill retry it
         if (
-            self.max_queue_depth
+            not offline
+            and self.max_queue_depth
             and len(self._pending) >= self.max_queue_depth
         ):
             # fail fast at the door: a queue this deep means every item
@@ -640,7 +758,15 @@ class DeviceBatcher:
 
         loop = asyncio.get_running_loop()
         future = loop.create_future()
-        item = _Item(kind, key, payload, future, current_deadline(), span)
+        item = _Item(
+            kind,
+            key,
+            payload,
+            future,
+            current_deadline(),
+            span,
+            lane="offline" if offline else "latency",
+        )
         if self._tok_pool is not None and kind in (
             "embed", "consensus", "ring_embed", "ring_vote"
         ):
@@ -654,7 +780,7 @@ class DeviceBatcher:
                 )
             except RuntimeError:  # pool shut down mid-close
                 item.prepared = None
-        self._pending.append(item)
+        (self._pending_offline if offline else self._pending).append(item)
         if self._flusher is None or self._flusher.done():
             self._flusher = loop.create_task(self._drain())
         elif self._wake is not None:
@@ -686,8 +812,8 @@ class DeviceBatcher:
             # waited behind the device)
             await asyncio.sleep(self.window_ms / 1000.0)
         inflight: set = set()
-        while self._pending or inflight:
-            if self._pending:
+        while self._pending or self._pending_offline or inflight:
+            if self._pending or self._pending_offline:
                 # bounded pipelining: wait for a dispatch slot FIRST and
                 # only then plan ONE group from whatever is pending —
                 # continuous admission: items arriving while earlier
@@ -750,8 +876,16 @@ class DeviceBatcher:
         the first chunk dispatches now, the remainder returns to the
         FRONT of the queue (they are the oldest same-key items) and
         dispatches next iteration — same chunk sizes as the snapshot
-        drain, one slot apart."""
-        pending = self._pending
+        drain, one slot apart.
+
+        Priority classes (ISSUE 20): the latency queue is ALWAYS
+        planned first; the offline queue contributes a group only when
+        no latency item is ready.  Because this selection re-runs after
+        every pipeline-slot acquire, an offline backlog yields the very
+        next slot to a latency arrival — the offline class can delay
+        latency work by at most the dispatch already in flight."""
+        from_latency = bool(self._pending)
+        pending = self._pending if from_latency else self._pending_offline
         if not pending:
             return []
         key = pending[0].key
@@ -782,13 +916,20 @@ class DeviceBatcher:
                 if item.key == key:
                     closed = True
                 rest.append(item)
-        self._pending = rest
+        if from_latency:
+            self._pending = rest
+        else:
+            self._pending_offline = rest
         if take and take[0].kind == "consensus" and key[0] == "consensus":
             chunks = list(self._pow2_chunks(take))
             if len(chunks) > 1:
-                self._pending = [
-                    i for c in chunks[1:] for i in c
-                ] + self._pending
+                remainder = [i for c in chunks[1:] for i in c]
+                if from_latency:
+                    self._pending = remainder + self._pending
+                else:
+                    self._pending_offline = (
+                        remainder + self._pending_offline
+                    )
                 take = chunks[0]
         return take
 
@@ -833,7 +974,7 @@ class DeviceBatcher:
     async def _run_group(self, loop, group) -> None:
         t0 = time.perf_counter()
         token = object()
-        self._inflight[token] = t0
+        self._inflight[token] = (t0, group[0].lane)
         from ..obs import phases as _phases
 
         for item in group:
@@ -989,7 +1130,14 @@ class DeviceBatcher:
             live.append(item)
         if not live:
             return
-        self._pending[:0] = live
+        # items return to the FRONT of their own lane's queue: a faulted
+        # offline group must not jump the latency class on re-dispatch
+        offline = [i for i in live if i.lane == "offline"]
+        latency = [i for i in live if i.lane != "offline"]
+        if latency:
+            self._pending[:0] = latency
+        if offline:
+            self._pending_offline[:0] = offline
         self.meshfault.note_redispatch(len(live))
         if self._wake is not None:
             self._wake.set()
@@ -1003,6 +1151,10 @@ class DeviceBatcher:
         self._busy.append((t0, end))
         self._dispatches += 1
         self._items += len(group)
+        lane = group[0].lane
+        self._lane_dispatches[lane] += 1
+        self._lane_items[lane] += len(group)
+        self._lane_busy[lane].append((t0, end))
         series = self._est_kind(group[0])
         if not error:
             # warm per-kind dispatch-time estimate for the deadline shed

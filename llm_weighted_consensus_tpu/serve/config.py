@@ -437,6 +437,31 @@ on like the phase histograms, these knobs tune or extend them):
 * ``LEDGER_DIR`` — append-only JSONL disk tier for the ledger:
   one self-describing line per record in ``ledger-<pid>.jsonl``
   (setting it also enables the ledger).
+* ``LEDGER_ROTATE_BYTES`` — rotate the active ledger file to a sealed
+  timestamped shard (``ledger-<pid>-<ts>-<seq>.jsonl``) once it
+  reaches this size; sealed shards still match the read glob, so
+  ``load_ledger_records`` and the train/ shard feed see every
+  generation.  ``0`` (the default) keeps one ever-growing file.
+
+Offline lane & weight learning (train/, weights/live.py — DESIGN.md
+"Offline lane & weight learning"):
+
+* ``WEIGHTS_ENABLED`` — arm the versioned live weight store and the
+  ``GET/PUT /v1/weights`` hot-swap endpoints; per-judge overrides
+  apply to every tally, the applied version is stamped on each
+  ``consensus:tally`` span and ledger record, and shadow-table
+  counters feed the quality scorecards.  Default off.
+* ``WEIGHTS_PATH`` — persist the live weight tables as JSON
+  (``lwc.weights.v1``) so a hot-swapped table survives a restart;
+  setting it implies ``WEIGHTS_ENABLED``.
+* ``OFFLINE_ENABLED`` — expose ``POST /v1/train/rescore``: an
+  admin-only drive of the batcher's offline priority class (archive
+  or synthetic candidate groups re-scored whenever the latency lane
+  has no ready group).  Default off; the offline class itself always
+  exists in the batcher.
+* ``OFFLINE_INFLIGHT`` — candidate groups the offline feeder keeps in
+  flight (its only backpressure; >= 2 sustains device occupancy on an
+  idle mesh).  Default 4.
 * ``JUDGE_BIAS_PLAN`` — deterministic per-judge vote perturbation at
   the extraction seam (the ``FAULT_PLAN`` contract applied to a judge's
   ballot), e.g. ``judge=2,after=16,flip=1.0,seed=7`` with kinds
@@ -821,9 +846,20 @@ class Config:
     quality_window: int = 64
     quality_drift_threshold: float = 0.25
     # consensus-outcome ledger (obs/ledger.py): ring capacity (0 = off
-    # unless ledger_dir is set) and the optional JSONL disk tier
+    # unless ledger_dir is set), the optional JSONL disk tier, and the
+    # size at which the active file seals into a timestamped shard
     ledger_ring: int = 0
     ledger_dir: Optional[str] = None
+    ledger_rotate_bytes: int = 0
+    # versioned live weight tables (weights/live.py): hot-swap via
+    # GET/PUT /v1/weights; weights_path persists them across restarts
+    # (and implies enabled)
+    weights_enabled: bool = False
+    weights_path: Optional[str] = None
+    # offline lane driver (train/feed.py): POST /v1/train/rescore gate
+    # and the feeder's in-flight group bound
+    offline_enabled: bool = False
+    offline_inflight: int = 4
     # deterministic judge-vote perturbation spec (JudgeBiasPlan.parse);
     # None = off (consensus-quality drills and tier-1 tests only)
     judge_bias_plan: Optional[str] = None
@@ -1037,6 +1073,13 @@ class Config:
             quality_drift_threshold=get_f("QUALITY_DRIFT_THRESHOLD", 0.25),
             ledger_ring=_non_negative_int(env, "LEDGER_RING", 0),
             ledger_dir=env.get("LEDGER_DIR"),
+            ledger_rotate_bytes=_non_negative_int(
+                env, "LEDGER_ROTATE_BYTES", 0
+            ),
+            weights_enabled=env_truthy(env.get("WEIGHTS_ENABLED", "0")),
+            weights_path=env.get("WEIGHTS_PATH"),
+            offline_enabled=env_truthy(env.get("OFFLINE_ENABLED", "0")),
+            offline_inflight=_non_negative_int(env, "OFFLINE_INFLIGHT", 4),
             judge_bias_plan=env.get("JUDGE_BIAS_PLAN"),
             fleet_self=env.get("FLEET_SELF"),
             fleet_peers=_parse_peer_list(env.get("FLEET_PEERS")),
@@ -1251,6 +1294,12 @@ class Config:
             from ..fleet.faults import FleetFaultPlan
 
             FleetFaultPlan.parse(config.fleet_fault_plan)
+        if config.offline_enabled and config.offline_inflight < 1:
+            raise ValueError(
+                f"OFFLINE_INFLIGHT={config.offline_inflight} must be >= 1 "
+                "(concurrent offline-lane groups; a zero-slot rescore "
+                "drive can never make progress)"
+            )
         return config
 
     def backoff_policy(self):
@@ -1377,7 +1426,19 @@ class Config:
         return OutcomeLedger(
             capacity=self.ledger_ring if self.ledger_ring > 0 else 256,
             disk_dir=self.ledger_dir,
+            rotate_bytes=self.ledger_rotate_bytes,
         )
+
+    def live_weights(self):
+        """The configured LiveWeightStore, or None when nothing enables
+        it (None keeps the scoring path on its static-weight reads —
+        resilience_policy() discipline).  WEIGHTS_PATH alone implies
+        enabled: pointing at a table means serving it."""
+        if not (self.weights_enabled or self.weights_path):
+            return None
+        from ..weights.live import LiveWeightStore
+
+        return LiveWeightStore(path=self.weights_path)
 
     def trace_sink(self):
         """The configured TraceSink, or None when nothing enables

@@ -351,6 +351,144 @@ def _judge_handlers():
     return index, get_one
 
 
+def _weights_handlers(live_weights):
+    """GET /v1/weights (active table + shadow counters) + PUT /v1/weights
+    (validated atomic hot-swap — ISSUE 20 tentpole piece c).
+
+    PUT body: ``{"weights": {judge_id: number, ...}, "version"?: str,
+    "mode"?: "active"|"shadow"}``.  ``mode: "shadow"`` stages the table
+    for would-have-flipped comparison without changing served verdicts;
+    ``"weights": {}`` with ``"mode"`` clears that slot.  The swap is one
+    assignment on the event loop, so in-flight tallies finish under the
+    version they captured and the next tally sees the new one — zero
+    client errors across a flip is the hot-swap drill's assertion."""
+
+    async def get_weights(request: web.Request):
+        return web.json_response(live_weights.wire())
+
+    async def put_weights(request: web.Request):
+        try:
+            body = jsonutil.loads(await request.text())
+        except Exception:
+            return web.json_response(
+                {"code": 400, "message": "body must be a JSON object"},
+                status=400,
+            )
+        if not isinstance(body, dict) or not isinstance(
+            body.get("weights"), dict
+        ):
+            return web.json_response(
+                {"code": 400, "message": 'body needs a "weights" object'},
+                status=400,
+            )
+        mode = body.get("mode", "active")
+        try:
+            if not body["weights"]:
+                live_weights.clear(mode=mode)
+                return web.json_response({"ok": True, "cleared": mode})
+            version = live_weights.put(
+                body["weights"], version=body.get("version"), mode=mode
+            )
+        except ValueError as e:
+            return web.json_response(
+                {"code": 400, "message": str(e)}, status=400
+            )
+        return web.json_response(
+            {"ok": True, "version": version, "mode": mode}
+        )
+
+    return get_weights, put_weights
+
+
+async def _weights_disabled(request: web.Request) -> web.Response:
+    """/v1/weights without WEIGHTS_ENABLED/WEIGHTS_PATH: explicit 403,
+    same contract as the /v1/profile guard."""
+    return web.json_response(
+        {
+            "code": 403,
+            "message": "live weights disabled: set WEIGHTS_ENABLED=1 "
+            "or WEIGHTS_PATH",
+        },
+        status=403,
+    )
+
+
+def _offline_rescore_handler(batcher, default_inflight: int = 4):
+    """POST /v1/train/rescore: saturate the offline priority class with
+    deterministic synthetic candidate groups and report the lane stats —
+    the HTTP face of ``python -m ...train rescore`` the bench drill
+    drives concurrently with latency traffic.
+
+    Body (all optional): ``{"groups": int, "n": int, "seed": int,
+    "inflight": int, "temperature": float}``.  Runs the drive to
+    completion in-handler and returns ``{groups, items, errors,
+    offline_occupancy, lanes}`` so the caller gets the merged-interval
+    occupancy gauge in the same response.  One drive at a time (409 on
+    overlap) — two saturators would double-count each other's idle."""
+    import asyncio
+
+    lock = asyncio.Lock()
+
+    async def rescore(request: web.Request):
+        from ..train.feed import OfflineFeed, synthetic_groups
+
+        if lock.locked():
+            return web.json_response(
+                {"code": 409, "message": "a rescore drive is already running"},
+                status=409,
+            )
+        try:
+            body = jsonutil.loads(await request.text()) if (
+                request.can_read_body
+            ) else {}
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        try:
+            n_groups = max(1, min(int(body.get("groups", 32)), 4096))
+            n = max(2, min(int(body.get("n", 8)), MAX_CONSENSUS_CANDIDATES))
+            seed = int(body.get("seed", 0))
+            inflight = max(1, min(int(body.get("inflight", default_inflight)), 64))
+            temperature = float(body.get("temperature", 0.05))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"code": 400, "message": "rescore params must be numeric"},
+                status=400,
+            )
+        async with lock:
+            feed = OfflineFeed(batcher, inflight=inflight)
+            _results, occupancy = await feed.drive(
+                synthetic_groups(n_groups, n, seed=seed),
+                temperature=temperature,
+            )
+        return web.json_response(
+            {
+                "ok": True,
+                "groups": feed.groups,
+                "items": feed.items,
+                "errors": feed.errors,
+                "offline_occupancy": occupancy,
+                "lanes": batcher.utilization()["lanes"],
+            }
+        )
+
+    return rescore
+
+
+async def _offline_rescore_disabled(request: web.Request) -> web.Response:
+    """/v1/train/rescore without OFFLINE_ENABLED (or without a device
+    batcher): explicit 403, same contract as the /v1/profile guard."""
+    return web.json_response(
+        {
+            "code": 403,
+            "message": "offline lane disabled: set OFFLINE_ENABLED=1 "
+            "(and configure EMBED_MODEL)",
+        },
+        status=403,
+    )
+
+
 def _make_handler(params_cls, create_streaming, create_unary, fastpath=False):
     async def handler(request: web.Request):
         try:
@@ -627,12 +765,15 @@ def build_app(
     host_fastpath: bool = False,
     memguard=None,
     max_body_bytes: int = 0,
+    live_weights=None,
+    offline_enabled: bool = False,
+    offline_inflight: int = 4,
 ) -> web.Application:
     metrics = metrics or Metrics()
     register_resilience(metrics, resilience, fault_plan)
     register_overload(metrics, admission, watchdog, lifecycle, memguard)
     register_performance(metrics, _roofline_gauge(embedder))
-    register_quality(metrics, ledger)
+    register_quality(metrics, ledger, live_weights)
     if embedder is not None and batcher is None:
         from .batcher import DeviceBatcher
 
@@ -799,6 +940,22 @@ def build_app(
     judges_index, judges_get = _judge_handlers()
     app.router.add_get("/v1/judges", judges_index)
     app.router.add_get("/v1/judges/{judge_id}", judges_get)
+    if live_weights is not None:
+        weights_get, weights_put = _weights_handlers(live_weights)
+        app.router.add_get("/v1/weights", weights_get)
+        app.router.add_put("/v1/weights", weights_put)
+    else:
+        # registered either way so the guard is an explicit 403, not a
+        # confusable 404 (same contract as /v1/profile below)
+        app.router.add_get("/v1/weights", _weights_disabled)
+        app.router.add_put("/v1/weights", _weights_disabled)
+    if offline_enabled and batcher is not None:
+        app.router.add_post(
+            "/v1/train/rescore",
+            _offline_rescore_handler(batcher, default_inflight=offline_inflight),
+        )
+    else:
+        app.router.add_post("/v1/train/rescore", _offline_rescore_disabled)
     if profile_dir:
         start, stop, capture = _profile_handlers(profile_dir)
         app.router.add_post("/profile/start", start)

@@ -51,6 +51,7 @@ KNOWN_SECTIONS = (
     "lock_witness",
     "fleet",
     "memguard",
+    "weights",
 )
 
 # Every Prometheus family the text exposition may emit.  Same contract
@@ -77,6 +78,11 @@ KNOWN_PROM_FAMILIES = (
     "lwc_memguard_rss_bytes",
     "lwc_memguard_level",
     "lwc_memguard_trips",
+    "lwc_lane_dispatches",
+    "lwc_lane_items",
+    "lwc_lane_busy_fraction",
+    "lwc_weights_swaps",
+    "lwc_weights_shadow",
 )
 
 
@@ -407,6 +413,62 @@ def render_prometheus(metrics: Metrics) -> str:
                 f"{memguard.get(key, 0)}"
             )
 
+    batcher = metrics.provider_section("device_batcher")
+    if isinstance(batcher, dict) and isinstance(batcher.get("lanes"), dict):
+        lanes = sorted(batcher["lanes"].items())
+        lines += prom_family(
+            "lwc_lane_dispatches",
+            "counter",
+            "Device dispatches per priority class (latency/offline).",
+        )
+        for lane, row in lanes:
+            lines.append(
+                f'lwc_lane_dispatches_total{{lane="{_esc(lane)}"}} '
+                f"{row.get('dispatches', 0)}"
+            )
+        lines += prom_family(
+            "lwc_lane_items",
+            "counter",
+            "Items dispatched per priority class.",
+        )
+        for lane, row in lanes:
+            lines.append(
+                f'lwc_lane_items_total{{lane="{_esc(lane)}"}} '
+                f"{row.get('items', 0)}"
+            )
+        lines += prom_family(
+            "lwc_lane_busy_fraction",
+            "gauge",
+            "Device busy fraction attributed per priority class.",
+        )
+        for lane, row in lanes:
+            lines.append(
+                f'lwc_lane_busy_fraction{{lane="{_esc(lane)}"}} '
+                f"{row.get('busy_fraction', 0.0):.6g}"
+            )
+
+    weights = metrics.provider_section("weights")
+    if isinstance(weights, dict):
+        lines += prom_family(
+            "lwc_weights_swaps",
+            "counter",
+            "Live weight-table installs (active + shadow).",
+        )
+        lines.append(f"lwc_weights_swaps_total {weights.get('swaps', 0)}")
+        lines += prom_family(
+            "lwc_weights_shadow",
+            "counter",
+            "Shadow-table comparisons by kind (compared/would_flip).",
+        )
+        for kind, key in (
+            ("compared", "shadow_compared"),
+            ("would_flip", "shadow_would_flip"),
+        ):
+            lines.append(
+                f'lwc_weights_shadow_total{{kind="{kind}"}} '
+                f"{weights.get(key, 0)}"
+            )
+
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -465,16 +527,20 @@ def register_performance(metrics: Metrics, roofline=None) -> None:
         metrics.register_provider("roofline", roofline.snapshot)
 
 
-def register_quality(metrics: Metrics, ledger=None) -> None:
+def register_quality(metrics: Metrics, ledger=None, live_weights=None) -> None:
     """Surface the ISSUE 12 consensus-quality sections: the ``quality``
     aggregate (per-judge scorecards, pairwise kappa, drift flags,
-    margin histogram, outcome rates) and, when an outcome ledger is
-    configured, its ``ledger`` retention counters."""
+    margin histogram, outcome rates), plus — when configured — the
+    outcome ledger's ``ledger`` retention counters and the live
+    weight-table's ``weights`` section (active/shadow versions, swap
+    and shadow-comparison counters)."""
     from ..obs import quality as _quality
 
     metrics.register_provider("quality", _quality.quality_snapshot)
     if ledger is not None:
         metrics.register_provider("ledger", ledger.snapshot)
+    if live_weights is not None:
+        metrics.register_provider("weights", live_weights.snapshot)
 
 
 def _series(request) -> str:

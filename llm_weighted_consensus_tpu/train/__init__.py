@@ -1,7 +1,22 @@
-"""Training steps: contrastive encoder training + RM pairwise ranking.
+"""The offline training subsystem (ISSUE 20): lane feed, weight
+learner, and the model trainers.
 
-The reference has no training (SURVEY §5: "No training -> no checkpoints");
-this framework's trained-weight path needs two trainers:
+The serving engine closes its own loop here — serve -> ledger ->
+learn -> serve with better weights:
+
+* ``train.feed``  — streams ``LEDGER_DIR`` shards and archive records
+  into the batcher's **offline priority class** (``priority="offline"``
+  on ``DeviceBatcher.embed/consensus``): full-width dispatches that
+  only run when the latency lane has no ready group;
+* ``train.fit``   — fits per-judge consensus weights as one batched
+  JAX softmax optimization over every ledger record (dp-sharded on the
+  serving mesh as an offline-lane tenant), emitting the versioned
+  table ``weights/live.py`` hot-swaps via PUT /v1/weights;
+* ``__main__``    — ``python -m llm_weighted_consensus_tpu.train
+  rescore|fit``, the operator CLI for both.
+
+This module keeps the lower-level model trainers the trained-weight
+path needs:
 
 * ``contrastive_train_step`` — bge-style InfoNCE over (query, positive)
   pairs with in-batch negatives: the recipe that produces the embedding
@@ -16,6 +31,18 @@ on the param pytree (see ``save_checkpoint``/``load_checkpoint``).
 """
 
 from __future__ import annotations
+
+__all__ = [
+    "make_optimizer",
+    "contrastive_loss",
+    "contrastive_train_step",
+    "reward_pairwise_loss",
+    "reward_train_step",
+    "save_checkpoint",
+    "load_checkpoint",
+    "save_train_state",
+    "load_train_state",
+]
 
 from functools import partial
 from typing import Optional

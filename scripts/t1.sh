@@ -40,9 +40,10 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_host_fastpat
 # host-path perf budget gate: bench_host.py --hostpath measures the
 # fast lane's per-phase p50s (ingest/merge/tally/encode + per-chunk
 # composite) at J=8 x N=64 and fails when any phase exceeds the
-# committed analysis/host_budgets.json budget x band (a >=25% host-path
-# regression).  Re-baseline with --write-budgets (DESIGN.md "Host fast
-# path").
+# committed analysis/host_budgets.json budget x band x machine_scale
+# (a >=25% host-path regression; the machine-speed canary re-prices
+# the limits when shared-host throttling slows the whole box).
+# Re-baseline with --write-budgets (DESIGN.md "Host fast path").
 timeout -k 10 300 env JAX_PLATFORMS=cpu python bench_host.py --hostpath > /tmp/_t1_hostpath.json; rc_hp=$?; [ $rc -eq 0 ] && rc=$rc_hp; \
 # hostile-ingest + memory-governor tests, explicitly: the byte-budget
 # plane (parser cap trips against the committed corpus, the four
@@ -58,6 +59,13 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_hostile_inge
 # the host-path per-chunk p50 — the budget plane must stay effectively
 # free on the hot loop.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python bench_host.py --ingest-bounds > /tmp/_t1_ingest.json; rc_ib=$?; [ $rc -eq 0 ] && rc=$rc_ib; \
+# offline-lane + weight-learner tests, explicitly: the priority-class
+# scheduler (latency-first planning, shed exemption, lane occupancy),
+# ledger shard rotation, the miscalibrated-panel learner drill (fitted
+# accuracy beats the observed base weights on held-out records), and
+# the /v1/weights hot-swap drill (version flip mid-traffic, zero client
+# errors) must fail tier-1 by name even if the glob's collection breaks.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_train.py -q -p no:cacheprovider -p no:xdist -p no:randomly; rc_tr=$?; [ $rc -eq 0 ] && rc=$rc_tr; \
 # analysis gate, explicitly: tests/test_analysis.py runs the same checker
 # under pytest, but naming the CLI here means a lint finding, a jaxpr
 # serving-path regression, or a mesh-audit failure (sharding coverage /
